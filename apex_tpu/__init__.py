@@ -1,0 +1,36 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A from-scratch rebuild of the capability surface of NVIDIA Apex
+(reference: ``guanbin1994/apex``) on JAX/XLA/Pallas/pjit:
+
+- ``apex_tpu.amp``        — mixed precision: O0–O3 policies, dynamic loss
+  scaling, trace-time autocast (the TPU-native analog of
+  ``apex/amp/frontend.py`` + ``apex/amp/scaler.py``; see SURVEY.md §2.1).
+- ``apex_tpu.optimizers`` — FusedAdam / FusedLAMB / FusedSGD / FusedNovoGrad /
+  FusedAdagrad lowered to single fused XLA computations over flat buffers
+  (analog of ``apex/optimizers/*`` + ``csrc/multi_tensor_*.cu``).
+- ``apex_tpu.multi_tensor_apply`` — the ``multi_tensor_applier`` dispatch
+  surface (analog of ``apex/multi_tensor_apply/multi_tensor_apply.py``).
+- ``apex_tpu.normalization`` — FusedLayerNorm / FusedRMSNorm backed by Pallas
+  TPU kernels (analog of ``apex/normalization/fused_layer_norm.py`` +
+  ``csrc/layer_norm_cuda_kernel.cu``).
+- ``apex_tpu.parallel``   — DistributedDataParallel-semantics gradient
+  synchronization, SyncBatchNorm, LARC over ``jax.lax.psum`` on ICI/DCN
+  (analog of ``apex/parallel/*``).
+- ``apex_tpu.transformer`` — Megatron-style tensor/pipeline/sequence
+  parallelism on a named device mesh (analog of ``apex/transformer/*``).
+- ``apex_tpu.contrib``    — xentropy, clip_grad, sparsity (ASP), multihead
+  attention, distributed (ZeRO-style) optimizers (analog of ``apex/contrib``).
+
+Design stance (SURVEY.md §7): a functional JAX core with an apex-shaped API
+veneer — capability and knob parity with the reference, mesh/pjit-native
+internals. Nothing in here is a port; the reference is CUDA/C++/torch.
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import amp  # noqa: F401
+from apex_tpu import multi_tensor_apply  # noqa: F401
+from apex_tpu import optimizers  # noqa: F401
+from apex_tpu import normalization  # noqa: F401
+from apex_tpu import parallel  # noqa: F401

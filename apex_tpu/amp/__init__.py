@@ -1,0 +1,31 @@
+"""apex_tpu.amp — mixed precision for TPU.
+
+Rebuild of ``apex.amp`` (SURVEY.md §2.1): O0–O3 opt-level properties,
+trace-time autocast (the O1 monkey-patch analog), dynamic loss scaling as a
+jit-carried pytree, and the ``initialize``/``scale_loss``/``state_dict``
+surface.
+"""
+
+from apex_tpu.amp._amp_state import (  # noqa: F401
+    maybe_print,
+    set_ingraph_logging,
+    set_verbosity,
+)
+from apex_tpu.amp.autocast import (  # noqa: F401
+    autocast,
+    float_function,
+    half_function,
+    promote_function,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    O0,
+    O1,
+    O2,
+    O3,
+    Properties,
+    cast_model,
+    initialize,
+    opt_levels,
+)
+from apex_tpu.amp.handle import AmpHandle  # noqa: F401
+from apex_tpu.amp.scaler import DynamicLossScaler, LossScaler, ScalerState  # noqa: F401

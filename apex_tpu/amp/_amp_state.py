@@ -1,0 +1,60 @@
+"""Process-global amp bookkeeping.
+
+Analog of the reference's ``apex/amp/_amp_state.py`` (SURVEY.md §5
+metrics/observability row): holds the verbosity knob consulted by
+``maybe_print`` and the overflow log line. In the rebuild almost all state
+is carried functionally; only human-facing verbosity lives here.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+class AmpState:
+    def __init__(self):
+        self.verbosity = 1
+        self.allow_incoming_model_not_fp32 = False
+        # None = auto-detect: in-graph overflow logging uses jax.debug.print
+        # (a host callback), which some TPU runtimes (axon PJRT) reject at
+        # run time. Auto enables it only on the CPU backend; set explicitly
+        # via set_ingraph_logging() to override.
+        self.ingraph_logging = None
+
+    def maybe_print(self, msg: str, rank0: bool = False):
+        if self.verbosity >= 1:
+            print(msg, file=sys.stderr)
+
+
+_amp_state = AmpState()
+
+
+def get_verbosity() -> int:
+    return _amp_state.verbosity
+
+
+def set_verbosity(v: int):
+    _amp_state.verbosity = v
+
+
+def maybe_print(msg: str):
+    _amp_state.maybe_print(msg)
+
+
+def set_ingraph_logging(enabled):
+    """Force in-graph (jax.debug.print) overflow logging on or off.
+
+    Pass None to restore auto-detection (enabled only on the CPU backend,
+    where host callbacks always work)."""
+    _amp_state.ingraph_logging = enabled
+
+
+def ingraph_logging_enabled() -> bool:
+    if _amp_state.ingraph_logging is not None:
+        return _amp_state.ingraph_logging
+    import jax
+
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return False

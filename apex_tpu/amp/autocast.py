@@ -1,0 +1,162 @@
+"""Trace-time autocast: the TPU-native analog of apex O1 monkey-patching.
+
+The reference's O1 mode (``apex/amp/amp.py`` + ``wrap.py``, SURVEY.md §3.1)
+intercepts ``torch.*`` calls at runtime, casting inputs of whitelisted ops
+to fp16 and blacklisted ops to fp32 per the tables in ``apex/amp/lists/``.
+
+There is no runtime dispatch to intercept in JAX — but there is a trace.
+:class:`autocast` patches the same op surface (``jax.numpy`` / ``jax.nn`` /
+``jax.lax`` functions per :mod:`apex_tpu.amp.lists`) for the duration of a
+``with`` block, so any model traced inside it gets the casts baked into its
+jaxpr. Because casting happens at trace time, XLA CSE subsumes apex's
+"cast cache" (repeated casts of the same weight dedupe for free), and the
+cast graph is identical on every step — no per-iteration patch overhead at
+all, which is strictly better than the reference's per-call wrappers.
+
+Nesting follows torch/apex semantics: the innermost active context wins,
+so ``autocast(enabled=False)`` inside an enabled region restores full
+precision for its extent. Implementation: wrappers are installed once and
+consult a context stack at call time.
+
+Patching module attributes is thread-local-unsafe by nature (as is apex's);
+use one autocast context per trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import importlib
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+
+# Stack of active autocast contexts; wrappers consult the top at call time
+# so nested contexts (including enabled=False) compose correctly.
+_STACK = []
+# (holder, name, orig) for installed wrappers; installed lazily on first
+# enter, removed when the stack empties.
+_INSTALLED = []
+
+
+def _resolve(module_path: str, attr: str):
+    mod = importlib.import_module(module_path)
+    holder = mod
+    parts = attr.split(".")
+    for p in parts[:-1]:
+        holder = getattr(holder, p)
+    return holder, parts[-1]
+
+
+def _cast_args(args, kwargs, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and hasattr(x, "astype") and jnp.issubdtype(
+            jnp.result_type(x), jnp.floating
+        ):
+            return x.astype(dtype)
+        # Recurse only into plain containers: NamedTuples (e.g.
+        # lax.ConvDimensionNumbers) must pass through untouched.
+        if type(x) in (tuple, list):
+            return type(x)(cast(v) for v in x)
+        return x
+
+    return tuple(cast(a) for a in args), {k: cast(v) for k, v in kwargs.items()}
+
+
+def _active():
+    """The innermost enabled-or-disabled context, or None outside any."""
+    return _STACK[-1] if _STACK else None
+
+
+def _install():
+    if _INSTALLED:
+        return
+    for table, kind in ((lists.WHITELIST, "lo"), (lists.BLACKLIST, "fp32")):
+        for module_path, attr in table:
+            try:
+                holder, name = _resolve(module_path, attr)
+                orig = getattr(holder, name)
+            except (ImportError, AttributeError):
+                continue  # op absent in this jax version; skip like apex does
+
+            def make_wrapper(orig_fn, op_kind):
+                def wrapper(*args, **kwargs):
+                    ctx = _active()
+                    if ctx is None or not ctx.enabled:
+                        return orig_fn(*args, **kwargs)
+                    dtype = jnp.float32 if op_kind == "fp32" else ctx.compute_dtype
+                    args, kwargs = _cast_args(args, kwargs, dtype)
+                    return orig_fn(*args, **kwargs)
+
+                wrapper.__name__ = getattr(orig_fn, "__name__", "wrapped")
+                wrapper.__wrapped_by_amp__ = True
+                return wrapper
+
+            setattr(holder, name, make_wrapper(orig, kind))
+            _INSTALLED.append((holder, name, orig))
+
+
+def _uninstall():
+    for holder, name, orig in reversed(_INSTALLED):
+        setattr(holder, name, orig)
+    _INSTALLED.clear()
+
+
+class autocast(contextlib.ContextDecorator):
+    """Context manager enabling O1-style cast interception at trace time.
+
+    Args:
+      compute_dtype: dtype for whitelisted (MXU) ops. Default bf16 — the
+        reference casts to fp16 on CUDA; on TPU the native low-precision
+        matmul type is bf16 (the north star's "O1–O3 emit bf16").
+      enabled: pass False to locally restore default precision (the
+        torch/apex idiom for precision-critical subgraphs).
+    """
+
+    def __init__(self, compute_dtype=jnp.bfloat16, enabled: bool = True):
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.enabled = enabled
+
+    def __enter__(self):
+        _install()
+        _STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        # Pop self (robust to exceptions raised between enter/exit).
+        if self in _STACK:
+            while _STACK and _STACK[-1] is not self:
+                _STACK.pop()
+            _STACK.pop()
+        if not _STACK:
+            _uninstall()
+        return False
+
+
+def half_function(fn):
+    """Register-style decorator marking ``fn`` to always run in the compute
+    dtype (analog of ``apex.amp.half_function``)."""
+
+    def wrapped(*args, **kwargs):
+        ctx = _active()
+        dtype = ctx.compute_dtype if ctx is not None else jnp.bfloat16
+        args, kwargs = _cast_args(args, kwargs, dtype)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def float_function(fn):
+    """Analog of ``apex.amp.float_function``: force fp32 inputs."""
+
+    def wrapped(*args, **kwargs):
+        args, kwargs = _cast_args(args, kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def promote_function(fn):
+    """Analog of ``apex.amp.promote_function``: jax.numpy promotion already
+    promotes to widest; returned unchanged for API parity."""
+    return fn

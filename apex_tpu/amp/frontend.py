@@ -1,0 +1,256 @@
+"""Opt-level properties and ``amp.initialize``.
+
+Rebuild of ``apex/amp/frontend.py`` (SURVEY.md §3.1 / §5 config row): the
+O0–O3 ``Properties`` table is preserved verbatim as the amp API contract —
+each opt level selects defaults for ``cast_model_type``,
+``patch_torch_functions`` (here: trace-time autocast),
+``keep_batchnorm_fp32``, ``master_weights`` and ``loss_scale``; explicit
+keyword arguments override the level defaults, and overriding a property an
+opt level forbids raises, exactly like the reference.
+
+TPU deltas (documented, intentional):
+- the low-precision dtype defaults to **bfloat16** (the MXU-native type)
+  instead of fp16; pass ``cast_model_type=jnp.float16`` to force fp16.
+- "model" is a params pytree and casting is functional: ``initialize``
+  returns new params rather than mutating modules.
+- dynamic loss scaling is retained even for bf16 (the north star requires
+  the scaler machinery intact; with bf16 it simply rarely triggers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.autocast import autocast
+from apex_tpu.amp.handle import AmpHandle
+from apex_tpu.amp.scaler import LossScaler
+
+
+@dataclasses.dataclass
+class Properties:
+    """The resolved amp property set (reference: ``frontend.Properties``)."""
+
+    opt_level: str = "O0"
+    cast_model_type: Optional[Any] = None
+    patch_torch_functions: bool = False  # name kept for parity; = autocast
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[str, float] = 1.0
+    enabled: bool = True
+
+    @property
+    def compute_dtype(self):
+        return self.cast_model_type if self.cast_model_type is not None else jnp.bfloat16
+
+
+class O0:
+    brief = "O0: Pure fp32 training."
+    more = "Calls .float() on your model, no-ops everything else."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O1:
+    brief = "O1: Insert automatic casts around safe-to-low-precision functions."
+    more = ("The model's weights remain fp32; listed functions run in the "
+            "compute dtype (bf16 on TPU) via trace-time autocast.")
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O2:
+    brief = "O2: Cast the model to the compute dtype, keep norms in fp32, use fp32 master weights."
+    more = ("Params are cast to bf16 except normalization params; the "
+            "optimizer keeps fp32 master weights; dynamic loss scaling.")
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.opt_level = "O2"
+        properties.cast_model_type = jnp.bfloat16
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O3:
+    brief = "O3: Pure low-precision training."
+    more = "Everything in the compute dtype. A speed-of-light baseline."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.opt_level = "O3"
+        properties.cast_model_type = jnp.bfloat16
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O0": O0(), "O1": O1(), "O2": O2(), "O3": O3()}
+
+# Reference parity: properties each opt level refuses to override.
+_DISALLOWED = {
+    "O0": {"loss_scale": {"dynamic"}},
+    "O1": {},
+    "O2": {},
+    "O3": {},
+}
+
+# Default predicate for keep_batchnorm_fp32: matches normalization-param
+# path segments in common flax/haiku naming (BatchNorm_0, LayerNorm, bn1,
+# rmsnorm...). The reference keys off module type (torch BN modules);
+# functionally we key off the param path.
+_NORM_RE = re.compile(r"(?i)(batch|layer|group|rms|sync)?[_]?norm|(^|[._/])bn\d*($|[._/])")
+
+
+def _default_norm_filter(path: str) -> bool:
+    return bool(_NORM_RE.search(path))
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def cast_model(params, dtype, keep_fp32_filter: Optional[Callable[[str], bool]] = None):
+    """Cast floating leaves of ``params`` to ``dtype``, keeping leaves whose
+    path matches ``keep_fp32_filter`` in fp32 (the ``keep_batchnorm_fp32``
+    mechanic of O2)."""
+
+    def cast(path, x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if keep_fp32_filter is not None and keep_fp32_filter(_path_str(path)):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def initialize(
+    params,
+    optimizers=None,
+    opt_level: str = "O1",
+    enabled: bool = True,
+    cast_model_type=None,
+    patch_torch_functions: Optional[bool] = None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale: Union[str, float, None] = None,
+    num_losses: int = 1,
+    verbosity: int = 1,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    keep_fp32_filter: Optional[Callable[[str], bool]] = None,
+):
+    """Functional ``amp.initialize`` (reference: ``apex/amp/frontend.py``).
+
+    Args mirror the reference signature. ``params`` is the model param
+    pytree ("model"); ``optimizers`` is one of our Fused* optimizers (or a
+    list of them, or None). Returns ``(params, optimizers, amp)`` where
+    ``amp`` is an :class:`~apex_tpu.amp.handle.AmpHandle` holding the
+    resolved :class:`Properties`, one :class:`LossScaler` per loss, and the
+    ``state_dict``/``load_state_dict``/``scale_loss`` surface.
+    """
+    _amp_state.set_verbosity(verbosity)
+
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3'."
+        )
+
+    properties = opt_levels[opt_level](Properties())
+    properties.enabled = enabled
+    _amp_state.maybe_print(f"Selected optimization level {opt_level}")
+    _amp_state.maybe_print(opt_levels[opt_level].brief)
+
+    for name, value in (
+        ("cast_model_type", cast_model_type),
+        ("patch_torch_functions", patch_torch_functions),
+        ("keep_batchnorm_fp32", keep_batchnorm_fp32),
+        ("master_weights", master_weights),
+        ("loss_scale", loss_scale),
+    ):
+        if value is not None:
+            bad = _DISALLOWED.get(opt_level, {}).get(name)
+            if bad and value in bad:
+                raise ValueError(f"Currently, {name}={value!r} is not supported with opt_level={opt_level}")
+            setattr(properties, name, value)
+
+    if not enabled:
+        # The reference contract: enabled=False means "as if amp were
+        # absent" but with the full API surface intact — so hand back a
+        # static unity scaler whose update is a no-op.
+        properties.patch_torch_functions = False
+        return params, optimizers, AmpHandle(
+            properties,
+            [LossScaler(loss_scale=1.0, loss_id=i) for i in range(num_losses)],
+            autocast(enabled=False),
+        )
+
+    # Model casting (O2/O3).
+    if properties.cast_model_type is not None and properties.cast_model_type != jnp.float32:
+        norm_filter = None
+        if properties.keep_batchnorm_fp32:
+            norm_filter = keep_fp32_filter or _default_norm_filter
+        params = cast_model(params, properties.cast_model_type, norm_filter)
+    elif properties.cast_model_type == jnp.float32:
+        params = cast_model(params, jnp.float32)
+
+    # Loss scalers, one per loss (reference: num_losses). min_loss_scale
+    # stays None unless the user sets it (reference default: no floor).
+    scalers = [
+        LossScaler(
+            loss_scale=properties.loss_scale,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+            loss_id=i,
+        )
+        for i in range(num_losses)
+    ]
+
+    # Optimizer master-weight configuration: our Fused* optimizers take a
+    # ``master_weights`` flag (reference: _process_optimizer's
+    # lazy_init_with_master_weights, SURVEY.md §3.1).
+    single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single else list(optimizers)
+    new_opts = []
+    for opt in opt_list:
+        if opt is not None and properties.master_weights and hasattr(opt, "with_master_weights"):
+            opt = opt.with_master_weights(True)
+        new_opts.append(opt)
+    optimizers = new_opts[0] if single else new_opts
+
+    cast_ctx = autocast(
+        compute_dtype=properties.compute_dtype
+        if properties.cast_model_type is None
+        else properties.cast_model_type,
+        enabled=properties.patch_torch_functions,
+    )
+    handle = AmpHandle(properties, scalers, cast_ctx)
+    return params, optimizers, handle

@@ -1,0 +1,92 @@
+"""The amp handle: scalers, state_dict, scale_loss.
+
+Rebuild of ``apex/amp/handle.py`` (SURVEY.md §3.2). The reference's
+``scale_loss`` is a context manager around ``backward()``; in the
+functional rebuild the equivalent one-stop helper is
+:meth:`AmpHandle.value_and_grad`, which scales the loss, differentiates,
+unscales, and surfaces the overflow flag for in-graph step skipping.
+
+``state_dict()``/``load_state_dict()`` round-trip loss-scaler state with
+the same key shape as the reference (``"loss_scaler0": {...}``), the
+contract pinned by ``tests/L0/run_amp/test_checkpointing.py`` upstream.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+
+class AmpHandle:
+    def __init__(self, properties, scalers: List[LossScaler], cast_ctx):
+        self._properties = properties
+        self.scalers = scalers
+        self.autocast = cast_ctx
+        # Mutable mirror of the traced scaler states for checkpointing in
+        # the stateful veneer. Functional users carry ScalerStates
+        # themselves and may ignore this.
+        self.scaler_states = [s.init() for s in scalers]
+
+    # -- properties passthrough (reference: amp handle exposes Properties) --
+    @property
+    def opt_level(self):
+        return self._properties.opt_level
+
+    @property
+    def properties(self):
+        return self._properties
+
+    # -- functional step surface -----------------------------------------
+    def init_state(self, loss_id: int = 0) -> ScalerState:
+        return self.scalers[loss_id].init()
+
+    def value_and_grad(self, loss_fn, state: ScalerState, loss_id: int = 0,
+                       has_aux: bool = False):
+        """Scaled value_and_grad; see :meth:`LossScaler.value_and_grad`.
+
+        If this handle's opt level patches functions (O1), the loss_fn is
+        traced under the autocast context so whitelist/blacklist casts bake
+        into the jaxpr.
+        """
+        scaler = self.scalers[loss_id]
+
+        def traced(*args, **kwargs):
+            if self._properties.patch_torch_functions:
+                with self.autocast:
+                    return loss_fn(*args, **kwargs)
+            return loss_fn(*args, **kwargs)
+
+        return scaler.value_and_grad(traced, state, has_aux=has_aux)
+
+    def scale_loss(self, loss, state: ScalerState, loss_id: int = 0):
+        """Scale a loss value (enter half of the reference context manager)."""
+        return self.scalers[loss_id].scale(loss, state)
+
+    def unscale(self, grads, state: ScalerState, loss_id: int = 0):
+        return self.scalers[loss_id].unscale(grads, state)
+
+    def update_scale(self, state: ScalerState, found_inf, loss_id: int = 0):
+        return self.scalers[loss_id].update(state, found_inf)
+
+    # -- checkpointing (reference key shape: "loss_scaler0") --------------
+    def state_dict(self):
+        out = {}
+        for i, st in enumerate(self.scaler_states):
+            out[f"loss_scaler{i}"] = {
+                "loss_scale": float(st.loss_scale),
+                "unskipped": int(st.unskipped),
+                "steps_skipped": int(st.steps_skipped),
+            }
+        return out
+
+    def load_state_dict(self, state_dict):
+        for i in range(len(self.scaler_states)):
+            entry = state_dict[f"loss_scaler{i}"]
+            self.scaler_states[i] = ScalerState(
+                loss_scale=jnp.asarray(entry["loss_scale"], jnp.float32),
+                unskipped=jnp.asarray(entry["unskipped"], jnp.int32),
+                steps_skipped=jnp.asarray(entry.get("steps_skipped", 0), jnp.int32),
+            )

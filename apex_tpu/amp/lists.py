@@ -1,0 +1,73 @@
+"""O1 autocast tables.
+
+Rebuild of the reference's ``apex/amp/lists/{functional_overrides,
+torch_overrides,tensor_overrides}.py`` (SURVEY.md §3.1): the fp16
+whitelist (matmul-class ops run in the low-precision compute dtype — the
+MXU path on TPU), the fp32 blacklist (reductions/transcendentals that are
+precision-sensitive), and the promote set.
+
+JAX note: the promote-to-widest behavior apex implements by hand for
+binary ops is native to ``jax.numpy`` type promotion, so no promote
+wrappers are installed; the table is kept for documentation parity.
+
+Entries are ``(module_path, attr_name)`` resolved at patch time so the
+same table drives both the patcher and introspection.
+"""
+
+# Ops cast to the policy compute dtype (bf16 on TPU): the FLOP carriers
+# that map onto the MXU. Mirrors apex's FP16_FUNCS (conv*, *mm variants,
+# matmul, linear, prelu...).
+WHITELIST = [
+    ("jax.numpy", "matmul"),
+    ("jax.numpy", "dot"),
+    ("jax.numpy", "vdot"),
+    ("jax.numpy", "inner"),
+    ("jax.numpy", "tensordot"),
+    ("jax.numpy", "einsum"),
+    ("jax.lax", "dot_general"),
+    ("jax.lax", "dot"),
+    ("jax.lax", "conv_general_dilated"),
+    ("jax.lax", "conv"),
+    ("jax.lax", "conv_with_general_padding"),
+]
+
+# Ops forced to fp32: mirrors apex's FP32_FUNCS (softmax/log_softmax,
+# exp/log/pow family, norms, losses, cumulative reductions).
+BLACKLIST = [
+    ("jax.numpy", "exp"),
+    ("jax.numpy", "expm1"),
+    ("jax.numpy", "log"),
+    ("jax.numpy", "log1p"),
+    ("jax.numpy", "log2"),
+    ("jax.numpy", "log10"),
+    ("jax.numpy", "power"),
+    ("jax.numpy", "float_power"),
+    ("jax.numpy", "cosh"),
+    ("jax.numpy", "sinh"),
+    ("jax.numpy", "tan"),
+    ("jax.numpy", "cumsum"),
+    ("jax.numpy", "cumprod"),
+    ("jax.numpy", "prod"),
+    ("jax.numpy", "linalg.norm"),
+    ("jax.nn", "softmax"),
+    ("jax.nn", "log_softmax"),
+    ("jax.nn", "standardize"),
+    ("jax.scipy.special", "logsumexp"),
+    ("jax.lax", "rsqrt"),
+    ("jax.lax", "erf_inv"),
+]
+
+# Binary ops whose mixed-dtype behavior apex resolves by promote-to-widest.
+# jax.numpy promotion already implements exactly this; listed for parity
+# docs / tests only. (apex: CASTS / SEQUENCE_CASTS promote tables.)
+PROMOTE = [
+    ("jax.numpy", "add"),
+    ("jax.numpy", "subtract"),
+    ("jax.numpy", "multiply"),
+    ("jax.numpy", "divide"),
+    ("jax.numpy", "equal"),
+    ("jax.numpy", "greater"),
+    ("jax.numpy", "less"),
+    ("jax.numpy", "minimum"),
+    ("jax.numpy", "maximum"),
+]

@@ -1,0 +1,9 @@
+from apex_tpu.utils.pytree import (  # noqa: F401
+    all_finite,
+    flatten_buckets,
+    global_norm,
+    ravel_list,
+    tree_cast,
+    tree_select,
+    unravel_list,
+)

@@ -1,0 +1,106 @@
+"""Pytree / flat-buffer utilities.
+
+TPU-native replacement for the reference's ``apex_C`` extension
+(``csrc/flatten_unflatten.cpp``, SURVEY.md §2.2): flattening a list of
+tensors into one contiguous buffer and back. Under XLA this is a
+``concatenate`` of raveled leaves — the compiler fuses the elementwise work
+that follows into a single pass over the buffer, which is the TPU analog of
+apex's one-kernel-launch-per-chunk ``multi_tensor_apply``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ravel_list(leaves):
+    """Flatten a list of arrays into one contiguous 1-D buffer.
+
+    Analog of ``apex_C.flatten``. Returns the flat buffer plus the
+    (shape, dtype, size) metadata needed by :func:`unravel_list`.
+    """
+    leaves = list(leaves)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32), []
+    meta = [(x.shape, x.dtype, x.size) for x in leaves]
+    flat = jnp.concatenate([jnp.ravel(x) for x in leaves])
+    return flat, meta
+
+
+def unravel_list(flat, meta):
+    """Inverse of :func:`ravel_list` (analog of ``apex_C.unflatten``)."""
+    out = []
+    offset = 0
+    for shape, dtype, size in meta:
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape).astype(dtype))
+        offset += size
+    return out
+
+
+def flatten_buckets(leaves, bucket_numel):
+    """Partition a list of arrays into buckets of at most ``bucket_numel``
+    total elements (greedy, preserving order), mirroring the reference DDP's
+    ``message_size``-element buckets (``apex/parallel/distributed.py``).
+
+    Returns a list of (indices, flat_buffer, meta) triples.
+    """
+    buckets = []
+    cur_idx, cur, cur_numel = [], [], 0
+    for i, leaf in enumerate(leaves):
+        if cur and cur_numel + leaf.size > bucket_numel:
+            flat, meta = ravel_list(cur)
+            buckets.append((cur_idx, flat, meta))
+            cur_idx, cur, cur_numel = [], [], 0
+        cur_idx.append(i)
+        cur.append(leaf)
+        cur_numel += leaf.size
+    if cur:
+        flat, meta = ravel_list(cur)
+        buckets.append((cur_idx, flat, meta))
+    return buckets
+
+
+def all_finite(tree):
+    """True iff every element of every floating leaf is finite.
+
+    The TPU-native overflow check: apex reads back a ``noop_flag`` buffer
+    written by ``multi_tensor_scale`` (a host sync); here the flag stays a
+    jit-carried bool consumed by ``lax.cond`` / ``jnp.where`` step-skipping.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()
+
+
+def tree_select(pred, on_true, on_false):
+    """Elementwise pytree select: ``pred ? on_true : on_false``.
+
+    Used for overflow step-skipping: both the applied and skipped optimizer
+    states are computed in-graph and selected, avoiding retrace-prone Python
+    control flow (SURVEY.md §7 hard part 1).
+    """
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+def tree_cast(tree, dtype):
+    """Cast every floating-point leaf of ``tree`` to ``dtype``."""
+    def cast(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(cast, tree)
+
+
+def global_norm(tree, ord=2):  # noqa: A002
+    """Global L2 norm over all leaves (fp32 accumulation)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    if ord != 2:
+        raise NotImplementedError("only the L2 global norm is supported")
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    return jnp.sqrt(jnp.stack(sq).sum())

@@ -1,0 +1,203 @@
+"""Opt-level property table + initialize() + autocast semantics.
+
+Mirrors upstream ``tests/L0/run_amp/test_basic_casts.py`` /
+``test_promotion.py`` coverage (SURVEY.md §4) on the TPU-native surface.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import apex_tpu.amp as amp
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))},
+        "BatchNorm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+
+
+def test_opt_level_properties():
+    _, _, h0 = amp.initialize(_params(), None, opt_level="O0", verbosity=0)
+    assert h0.properties.loss_scale == 1.0
+    assert not h0.properties.patch_torch_functions
+
+    _, _, h1 = amp.initialize(_params(), None, opt_level="O1", verbosity=0)
+    assert h1.properties.loss_scale == "dynamic"
+    assert h1.properties.patch_torch_functions
+    assert h1.properties.cast_model_type is None
+
+    _, _, h2 = amp.initialize(_params(), None, opt_level="O2", verbosity=0)
+    assert h2.properties.master_weights
+    assert h2.properties.keep_batchnorm_fp32
+    assert h2.properties.cast_model_type == jnp.bfloat16
+
+    _, _, h3 = amp.initialize(_params(), None, opt_level="O3", verbosity=0)
+    assert h3.properties.loss_scale == 1.0
+    assert not h3.properties.master_weights
+
+
+def test_bad_opt_level_raises():
+    with pytest.raises(ValueError):
+        amp.initialize(_params(), None, opt_level="O4", verbosity=0)
+
+
+def test_explicit_override_of_level_defaults():
+    _, _, h = amp.initialize(_params(), None, opt_level="O1", loss_scale=512.0, verbosity=0)
+    assert h.properties.loss_scale == 512.0
+    assert h.scalers[0].loss_scale == 512.0
+
+
+def test_o2_casts_model_but_keeps_norm_fp32():
+    p, _, _ = amp.initialize(_params(), None, opt_level="O2", verbosity=0)
+    assert p["dense"]["kernel"].dtype == jnp.bfloat16
+    assert p["dense"]["bias"].dtype == jnp.bfloat16
+    assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+    assert p["BatchNorm_0"]["bias"].dtype == jnp.float32
+
+
+def test_o3_casts_everything():
+    p, _, _ = amp.initialize(_params(), None, opt_level="O3", verbosity=0)
+    assert p["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_o1_leaves_model_fp32():
+    p, _, _ = amp.initialize(_params(), None, opt_level="O1", verbosity=0)
+    assert p["dense"]["kernel"].dtype == jnp.float32
+
+
+def test_autocast_whitelist_casts_matmul_to_bf16():
+    a = jnp.ones((8, 8), jnp.float32)
+    with amp.autocast():
+        out = jnp.matmul(a, a)
+    assert out.dtype == jnp.bfloat16
+    # restored afterwards
+    assert jnp.matmul(a, a).dtype == jnp.float32
+
+
+def test_autocast_blacklist_casts_softmax_to_fp32():
+    x = jnp.ones((4, 4), jnp.bfloat16)
+    with amp.autocast():
+        out = jax.nn.softmax(x)
+    assert out.dtype == jnp.float32
+
+
+def test_autocast_disabled_is_noop():
+    a = jnp.ones((8, 8), jnp.float32)
+    with amp.autocast(enabled=False):
+        assert jnp.matmul(a, a).dtype == jnp.float32
+
+
+def test_autocast_under_jit_trace():
+    """Casts bake into the traced graph (the cast-cache analog: tracing
+    dedupes repeated casts via CSE, so this is at least as cheap as the
+    reference's cached casts)."""
+    a = jnp.ones((8, 8), jnp.float32)
+
+    def f(x):
+        with amp.autocast():
+            return jnp.matmul(x, x)
+
+    out = jax.jit(f)(a)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_autocast_inner_disabled_wins():
+    """torch/apex idiom: autocast(enabled=False) inside an enabled region
+    restores full precision for its extent (innermost wins)."""
+    a = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast():
+        with amp.autocast(enabled=False):
+            assert jnp.matmul(a, a).dtype == jnp.float32
+        assert jnp.matmul(a, a).dtype == jnp.bfloat16
+    assert jnp.matmul(a, a).dtype == jnp.float32
+
+
+def test_autocast_inner_dtype_wins():
+    a = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast(compute_dtype=jnp.bfloat16):
+        with amp.autocast(compute_dtype=jnp.float16):
+            assert jnp.matmul(a, a).dtype == jnp.float16
+        assert jnp.matmul(a, a).dtype == jnp.bfloat16
+
+
+def test_autocast_passes_namedtuple_args_through():
+    """lax.conv_general_dilated with explicit ConvDimensionNumbers must not
+    be mangled by arg casting."""
+    x = jnp.ones((1, 8, 8, 3), jnp.float32)
+    w = jnp.ones((3, 3, 3, 4), jnp.float32)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+    with amp.autocast():
+        out = jax.lax.conv_general_dilated(x, w, (1, 1), "SAME", dimension_numbers=dn)
+    assert out.shape == (1, 8, 8, 4)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_enabled_false_handle_is_usable():
+    """Reference contract: enabled=False runs as if amp were absent, with
+    the API surface intact."""
+    params = {"w": jnp.ones((4,))}
+    p, _, h = amp.initialize(params, None, opt_level="O2", enabled=False, verbosity=0)
+    st = h.init_state()
+    assert float(st.loss_scale) == 1.0
+    (loss, found), grads = h.value_and_grad(lambda q: jnp.sum(q["w"] ** 2), st)(p)
+    assert not bool(found)
+    st2 = h.update_scale(st, found)
+    assert float(st2.loss_scale) == 1.0  # static unity scaler never moves
+
+
+def test_autocast_nesting_restores_correctly():
+    a = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast():
+        with amp.autocast():
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+        assert jnp.matmul(a, a).dtype == jnp.bfloat16
+    assert jnp.matmul(a, a).dtype == jnp.float32
+
+
+def test_promotion_is_native():
+    """apex's promote-to-widest is jax.numpy's native behavior."""
+    a = jnp.ones((4,), jnp.bfloat16)
+    b = jnp.ones((4,), jnp.float32)
+    assert (a + b).dtype == jnp.float32
+
+
+def test_state_dict_roundtrip():
+    """Checkpoint contract (upstream test_checkpointing.py)."""
+    _, _, h = amp.initialize(_params(), None, opt_level="O2", verbosity=0)
+    h.scaler_states[0] = h.scaler_states[0]._replace(
+        loss_scale=jnp.asarray(4096.0, jnp.float32),
+        unskipped=jnp.asarray(17, jnp.int32),
+    )
+    sd = h.state_dict()
+    assert sd["loss_scaler0"]["loss_scale"] == 4096.0
+
+    _, _, h2 = amp.initialize(_params(), None, opt_level="O2", verbosity=0)
+    h2.load_state_dict(sd)
+    assert float(h2.scaler_states[0].loss_scale) == 4096.0
+    assert int(h2.scaler_states[0].unskipped) == 17
+
+
+def test_multiple_losses_get_independent_scalers():
+    _, _, h = amp.initialize(_params(), None, opt_level="O2", num_losses=3, verbosity=0)
+    assert len(h.scalers) == 3
+    st0 = h.init_state(0)
+    st0 = h.update_scale(st0, jnp.asarray(True), loss_id=0)
+    st1 = h.init_state(1)
+    assert float(st0.loss_scale) == 2.0 ** 15
+    assert float(st1.loss_scale) == 2.0 ** 16
+
+
+def test_handle_value_and_grad_end_to_end():
+    params = {"w": jnp.ones((4, 4))}
+    _, _, h = amp.initialize(params, None, opt_level="O1", verbosity=0)
+    st = h.init_state()
+
+    def loss_fn(p):
+        y = jnp.matmul(p["w"], p["w"])  # whitelisted: runs bf16 under O1
+        return jnp.sum(y.astype(jnp.float32))
+
+    (loss, found), grads = h.value_and_grad(loss_fn, st)(params)
+    assert not bool(found)
+    assert grads["w"].shape == (4, 4)

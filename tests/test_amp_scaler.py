@@ -1,0 +1,186 @@
+"""Loss-scaler contract tests.
+
+Pins the reference constants (SURVEY.md §3.2): init 2**16, backoff /2 on
+overflow, growth x2 every 2000 clean steps, max 2**24 — the behaviors
+upstream ``tests/L0/run_amp`` greps for.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.amp import LossScaler
+
+
+def test_init_scale_default():
+    scaler = LossScaler()
+    st = scaler.init()
+    assert float(st.loss_scale) == 2.0 ** 16
+
+
+def test_static_scale_never_changes():
+    scaler = LossScaler(loss_scale=128.0)
+    st = scaler.init()
+    assert float(st.loss_scale) == 128.0
+    st = scaler.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 128.0
+    assert int(st.steps_skipped) == 1
+
+
+def test_backoff_on_overflow():
+    scaler = LossScaler()
+    st = scaler.init()
+    st = scaler.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 2.0 ** 15
+    assert int(st.unskipped) == 0
+    assert int(st.steps_skipped) == 1
+
+
+def test_growth_after_interval():
+    scaler = LossScaler(scale_seq_len=4)  # shrink the 2000-step window
+    st = scaler.init()
+    for _ in range(3):
+        st = scaler.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0 ** 16
+    st = scaler.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 2.0 ** 17
+    assert int(st.unskipped) == 0
+
+
+def test_growth_capped_at_max():
+    scaler = LossScaler(scale_seq_len=1, max_loss_scale=2.0 ** 17)
+    st = scaler.init()
+    for _ in range(5):
+        st = scaler.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 2.0 ** 17
+
+
+def test_no_floor_by_default_backs_off_below_one():
+    """Reference default min_loss_scale=None: scale may go below 1.0, which
+    is how training recovers when grads overflow even at scale 1."""
+    scaler = LossScaler()
+    st = scaler.init()._replace(loss_scale=jnp.asarray(1.0, jnp.float32))
+    st = scaler.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 0.5
+
+
+def test_backoff_floored_at_min():
+    scaler = LossScaler(min_loss_scale=2.0 ** 15)
+    st = scaler.init()
+    for _ in range(5):
+        st = scaler.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 2.0 ** 15
+
+
+def test_unscale_detects_inf_and_nan():
+    scaler = LossScaler()
+    st = scaler.init()
+    good = {"w": jnp.ones((4,)) * st.loss_scale}
+    grads, found = scaler.unscale(good, st)
+    assert not bool(found)
+    assert jnp.allclose(grads["w"], 1.0)
+
+    bad = {"w": jnp.array([1.0, jnp.inf, 3.0, 4.0])}
+    _, found = scaler.unscale(bad, st)
+    assert bool(found)
+
+    nan = {"w": jnp.array([1.0, jnp.nan, 3.0, 4.0])}
+    _, found = scaler.unscale(nan, st)
+    assert bool(found)
+
+
+def test_value_and_grad_scales_and_unscales():
+    scaler = LossScaler(loss_scale=1024.0)
+    st = scaler.init()
+
+    def loss_fn(p):
+        return jnp.sum(p ** 2)
+
+    p = jnp.arange(4.0)
+    (loss, found), grads = scaler.value_and_grad(loss_fn, st)(p)
+    assert not bool(found)
+    # Reported loss is unscaled; grads are unscaled.
+    assert jnp.allclose(loss, jnp.sum(p ** 2))
+    assert jnp.allclose(grads, 2 * p)
+
+
+def test_step_skip_via_maybe_apply():
+    scaler = LossScaler()
+    st = scaler.init()
+    old = {"w": jnp.zeros((3,))}
+    new = {"w": jnp.ones((3,))}
+    # overflow -> keep old params, scale halves
+    tree, st2 = scaler.maybe_apply(st, jnp.asarray(True), new, old)
+    assert jnp.allclose(tree["w"], 0.0)
+    assert float(st2.loss_scale) == 2.0 ** 15
+    # clean -> take new params
+    tree, st3 = scaler.maybe_apply(st2, jnp.asarray(False), new, old)
+    assert jnp.allclose(tree["w"], 1.0)
+
+
+def test_whole_step_is_jittable():
+    """The scaler must live happily inside one jit (no host sync)."""
+    scaler = LossScaler()
+
+    @jax.jit
+    def step(p, st, x):
+        def loss_fn(p):
+            return jnp.sum((p * x) ** 2)
+
+        (loss, found), grads = scaler.value_and_grad(loss_fn, st)(p)
+        newp = jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
+        p2, st2 = scaler.maybe_apply(st, found, newp, p)
+        return p2, st2, loss
+
+    p = jnp.ones((4,))
+    st = scaler.init()
+    p, st, loss = step(p, st, jnp.ones((4,)))
+    assert int(st.steps_skipped) == 0
+    # inject an overflow through the input
+    p_bad, st, _ = step(p, st, jnp.array([jnp.inf, 1.0, 1.0, 1.0]))
+    assert int(st.steps_skipped) == 1
+    assert float(st.loss_scale) == 2.0 ** 15
+    assert jnp.allclose(p_bad, p)  # step skipped
+
+
+def test_mnist_style_smoke_recovers_from_overflow():
+    """BASELINE configs[0]: 2-layer MLP, scaler backs off on an injected inf
+    then resumes training and the loss decreases."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(64, 16).astype("float32"))
+    Y = jnp.asarray((rng.randn(64) > 0).astype("int32"))
+
+    params = {
+        "w1": jnp.asarray(rng.randn(16, 32).astype("float32") * 0.1),
+        "b1": jnp.zeros((32,)),
+        "w2": jnp.asarray(rng.randn(32, 2).astype("float32") * 0.1),
+        "b2": jnp.zeros((2,)),
+    }
+    scaler = LossScaler()
+    st = scaler.init()
+
+    def loss_fn(p, scale_bomb):
+        h = jnp.tanh(X @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(logp[jnp.arange(64), Y])
+        return loss * scale_bomb  # scale_bomb=inf injects an overflow
+
+    @jax.jit
+    def step(p, st, bomb):
+        (loss, found), grads = scaler.value_and_grad(lambda q: loss_fn(q, bomb), st)(p)
+        newp = jax.tree.map(lambda a, g: a - 0.5 * g, p, grads)
+        p2, st2 = scaler.maybe_apply(st, found, newp, p)
+        return p2, st2, loss
+
+    losses = []
+    for i in range(30):
+        bomb = jnp.asarray(jnp.inf if i == 5 else 1.0, jnp.float32)
+        params, st, loss = step(params, st, bomb)
+        if i != 5:
+            losses.append(float(loss))
+    assert int(st.steps_skipped) == 1
+    assert float(st.loss_scale) == 2.0 ** 15
+    assert losses[-1] < losses[0]
