@@ -1,0 +1,4 @@
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (  # noqa: F401
+    MultiTensorApply,
+    multi_tensor_applier,
+)

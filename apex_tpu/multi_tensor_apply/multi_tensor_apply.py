@@ -1,0 +1,36 @@
+"""The ``multi_tensor_applier`` dispatch surface.
+
+Rebuild of ``apex/multi_tensor_apply/multi_tensor_apply.py`` (SURVEY.md
+§2.1): the thin dispatcher every fused optimizer routes through. The
+reference chunks tensor lists into ``chunk_size``-element pieces and
+launches one CUDA kernel per metadata batch; here the op itself performs
+the flat-buffer fusion (see :mod:`apex_tpu.ops.multi_tensor`), so the
+applier's job reduces to signature parity — call sites written for apex
+(``multi_tensor_applier(amp_C.multi_tensor_adam, overflow_buf, lists,
+*args)``) port unchanged.
+
+``chunk_size`` is retained (default ``2048*32``, the reference constant)
+and forwarded to ops; XLA makes its own tiling decisions, so it is
+advisory on TPU.
+"""
+
+from __future__ import annotations
+
+
+class MultiTensorApply:
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
+        """Apply ``op`` over parallel ``tensor_lists``.
+
+        ``noop_flag_buffer`` is a traced bool scalar or None (the
+        functional stand-in for the reference's device int buffer).
+        """
+        return op(self.chunk_size, noop_flag_buffer, tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

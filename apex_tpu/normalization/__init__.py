@@ -1,0 +1,15 @@
+"""apex_tpu.normalization — fused norms backed by Pallas TPU kernels
+(SURVEY.md §2.1 L3; kernels in apex_tpu.ops.layer_norm)."""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)

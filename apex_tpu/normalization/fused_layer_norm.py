@@ -1,0 +1,90 @@
+"""FusedLayerNorm / FusedRMSNorm modules.
+
+Rebuild of ``apex/normalization/fused_layer_norm.py`` (SURVEY.md §2.1):
+drop-in norm modules backed by the Pallas kernels in
+:mod:`apex_tpu.ops.layer_norm`. Provided as flax ``nn.Module`` s (the
+idiomatic JAX module system) with the reference's knob surface:
+``normalized_shape``, ``eps``, ``elementwise_affine``,
+``memory_efficient``; the ``MixedFused*`` variants pin fp32 params under
+low-precision activations (the reference's mixed-dtype contract).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+
+
+def _last_dim(normalized_shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    shape = tuple(normalized_shape)
+    if len(shape) != 1:
+        raise NotImplementedError(
+            "apex_tpu norms fuse over the last dimension; multi-dim "
+            "normalized_shape should be reshaped by the caller."
+        )
+    return shape[0]
+
+
+class FusedLayerNorm(nn.Module):
+    """Reference: ``apex.normalization.FusedLayerNorm``."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = _last_dim(self.normalized_shape)
+        if x.shape[-1] != h:
+            raise ValueError(f"expected trailing dim {h}, got {x.shape[-1]}")
+        if not self.elementwise_affine:
+            return fused_layer_norm(x, h, self.eps)
+        weight = self.param("scale", nn.initializers.ones, (h,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (h,), self.param_dtype)
+        return fused_layer_norm_affine(x, weight, bias, self.eps, self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    """Reference: ``apex.normalization.FusedRMSNorm``."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = _last_dim(self.normalized_shape)
+        if x.shape[-1] != h:
+            raise ValueError(f"expected trailing dim {h}, got {x.shape[-1]}")
+        if not self.elementwise_affine:
+            return fused_rms_norm(x, h, self.eps)
+        weight = self.param("scale", nn.initializers.ones, (h,), self.param_dtype)
+        return fused_rms_norm_affine(x, weight, self.eps, self.memory_efficient)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """fp32 params under low-precision activations (reference:
+    ``MixedFusedLayerNorm`` — the amp-O2 norm)."""
+
+    param_dtype: jnp.dtype = jnp.float32
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """Reference: ``MixedFusedRMSNorm``."""
+
+    param_dtype: jnp.dtype = jnp.float32

@@ -1,0 +1,8 @@
+"""apex_tpu.ops — the native-kernel stratum (L0 in SURVEY.md §1).
+
+Where the reference has CUDA kernels (``csrc/``), this package has XLA
+flat-buffer fusions (:mod:`apex_tpu.ops.multi_tensor`) and Pallas TPU
+kernels (:mod:`apex_tpu.ops.layer_norm`, :mod:`apex_tpu.ops.softmax`, ...).
+"""
+
+from apex_tpu.ops import multi_tensor  # noqa: F401

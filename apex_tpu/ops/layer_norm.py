@@ -1,0 +1,315 @@
+"""Pallas TPU kernels: fused LayerNorm / RMSNorm forward + backward.
+
+Rebuild of the reference's ``csrc/layer_norm_cuda_kernel.cu`` (SURVEY.md
+§2.2 — an explicit north-star item): LayerNorm and RMSNorm fwd/bwd with
+affine and mixed-dtype variants (low-precision activations, fp32 weights —
+the ``MixedFused*`` / ``*AffineMixedDtypes`` surface).
+
+TPU design notes:
+- One grid dimension over row blocks; each kernel instance normalizes a
+  ``(block_rows, H)`` tile resident in VMEM. Row statistics are plain VPU
+  reductions along the lane dimension — the Welford/warp-shuffle machinery
+  of the CUDA kernel exists to cope with rows spread across threads, which
+  has no analog here.
+- The backward kernel *recomputes* (mean, rstd) from the x tile instead of
+  saving them: on TPU the recompute is two cheap VPU reductions over data
+  already in VMEM, cheaper than an extra HBM round-trip — the
+  rematerialization idiom (and the semantics of the reference's
+  ``memory_efficient=True`` mode, which it reaches by reconstructing
+  inputs).
+- Backward computes dx in one pass and per-block partial dgamma/dbeta into
+  a ``(grid, H)`` buffer summed outside — the TPU analog of the CUDA
+  two-pass ``cuComputeGradGammaBeta``.
+- All in-kernel arithmetic is fp32 regardless of I/O dtype (matching the
+  CUDA kernels' float accumulators).
+- H is padded to the 128-lane width by the wrapper when needed; padded
+  columns are masked in-kernel and statistics divide by the true H.
+
+On non-TPU backends the same kernels run under ``interpret=True`` so the
+test suite exercises identical code paths on the 8-device CPU sim.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _block_rows(n_rows: int) -> int:
+    """Row-block size. Callers pad the row count to a multiple of this, so
+    VMEM usage is bounded at (256, Hpad) tiles regardless of N (a single
+    all-rows tile would blow the ~16 MB VMEM budget for large N)."""
+    if n_rows >= 256:
+        return 256
+    return _round_up(max(n_rows, 1), 8)
+
+
+def _stats(x, true_h, rms):
+    """fp32 (mean, rstd) of the valid columns of a padded fp32 tile."""
+    h = jnp.float32(true_h)
+    if rms:
+        mean = jnp.zeros((x.shape[0], 1), jnp.float32)
+    else:
+        mean = jnp.sum(x, axis=1, keepdims=True) / h
+    centered = x - mean
+    return mean, centered
+
+
+def _mask_tile(x, true_h):
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(col < true_h, x, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, *, eps, true_h, rms, padded):
+    x = x_ref[:].astype(jnp.float32)
+    if padded:
+        x = _mask_tile(x, true_h)
+    h = jnp.float32(true_h)
+    mean, centered = _stats(x, true_h, rms)
+    if padded:
+        centered = _mask_tile(centered, true_h)
+    var = jnp.sum(centered * centered, axis=1, keepdims=True) / h
+    rstd = jax.lax.rsqrt(var + eps)
+    y = centered * rstd * w_ref[:].astype(jnp.float32)
+    if b_ref is not None:
+        y = y + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _fwd_kernel_b(x_ref, w_ref, b_ref, y_ref, **kw):
+    _fwd_kernel(x_ref, w_ref, b_ref, y_ref, **kw)
+
+
+def _fwd_kernel_nb(x_ref, w_ref, y_ref, **kw):
+    _fwd_kernel(x_ref, w_ref, None, y_ref, **kw)
+
+
+def _bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, eps, true_h, rms, padded):
+    g = g_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    if padded:
+        g = _mask_tile(g, true_h)
+        x = _mask_tile(x, true_h)
+    h = jnp.float32(true_h)
+
+    mean, centered = _stats(x, true_h, rms)
+    if padded:
+        centered = _mask_tile(centered, true_h)
+    var = jnp.sum(centered * centered, axis=1, keepdims=True) / h
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = centered * rstd
+    wg = g * w
+
+    # dgamma/dbeta partials for this row block
+    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+
+    # dx (standard fused layernorm backward)
+    c1 = jnp.sum(wg * xhat, axis=1, keepdims=True) / h
+    if rms:
+        dx = (wg - xhat * c1) * rstd
+    else:
+        c2 = jnp.sum(wg, axis=1, keepdims=True) / h
+        dx = (wg - xhat * c1 - c2) * rstd
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+def _pallas_forward(x2, weight, bias, *, eps, true_h, rms):
+    n, hpad = x2.shape
+    br = _block_rows(n)
+    kernel = functools.partial(
+        _fwd_kernel_nb if bias is None else _fwd_kernel_b,
+        eps=eps, true_h=true_h, rms=rms, padded=(true_h != hpad),
+    )
+    in_specs = [
+        pl.BlockSpec((br, hpad), lambda i: (i, 0)),
+        pl.BlockSpec((hpad,), lambda i: (0,)),
+    ]
+    args = [x2, weight]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((hpad,), lambda i: (0,)))
+        args.append(bias)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // br,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, hpad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, hpad), x2.dtype),
+        interpret=_interpret(),
+    )(*args)
+
+
+def _pallas_backward(g2, x2, weight, *, eps, true_h, rms):
+    n, hpad = x2.shape
+    br = _block_rows(n)
+    grid = n // br
+    kernel = functools.partial(
+        _bwd_kernel, eps=eps, true_h=true_h, rms=rms, padded=(true_h != hpad),
+    )
+    dx, dw_part, db_part = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((br, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((br, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((hpad,), lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((br, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((1, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((1, hpad), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, hpad), g2.dtype),
+            jax.ShapeDtypeStruct((grid, hpad), jnp.float32),
+            jax.ShapeDtypeStruct((grid, hpad), jnp.float32),
+        ),
+        interpret=_interpret(),
+    )(g2, x2, weight)
+    return dx, dw_part.sum(axis=0), db_part.sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# public functional API (custom_vjp)
+# ---------------------------------------------------------------------------
+
+def _prep(x, weight, bias):
+    """Flatten leading dims; pad H to the lane width and N to the row-block
+    size (padded rows are zeros: their stats are finite and their outputs
+    are sliced away; in backward their zero grads contribute nothing)."""
+    h = x.shape[-1]
+    lead = x.shape[:-1]
+    n = 1
+    for d in lead:
+        n *= d
+    x2 = x.reshape(n, h)
+    hpad = _round_up(h, LANE)
+    npad = _round_up(n, _block_rows(n))
+    if hpad != h or npad != n:
+        x2 = jnp.pad(x2, ((0, npad - n), (0, hpad - h)))
+        weight = jnp.pad(weight, (0, hpad - h))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, hpad - h))
+    return x2, weight, bias, lead, n, h, hpad
+
+
+def _fwd_impl(x, weight, bias, eps, rms):
+    x2, w2, b2, lead, n, h, hpad = _prep(x, weight, bias)
+    y2 = _pallas_forward(x2, w2, b2, eps=eps, true_h=h, rms=rms)
+    return y2[:n, :h].reshape(*lead, h)
+
+
+def _bwd_impl(g, x, weight, eps, rms):
+    x2, w2, _, lead, n, h, hpad = _prep(x, weight, None)
+    g2 = g.reshape(n, h)
+    npad = x2.shape[0]
+    if hpad != h or npad != n:
+        g2 = jnp.pad(g2, ((0, npad - n), (0, hpad - h)))
+    dx2, dw, db = _pallas_backward(g2, x2, w2, eps=eps, true_h=h, rms=rms)
+    return dx2[:n, :h].reshape(*lead, h), dw[:h], db[:h]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm_affine(x, weight, bias, eps: float = 1e-5,
+                            memory_efficient: bool = True):
+    """LayerNorm with affine transform, Pallas-fused fwd+bwd.
+
+    Reference surface: ``FusedLayerNormAffineFunction`` /
+    ``FusedLayerNormAffineMixedDtypesFunction``
+    (``apex/normalization/fused_layer_norm.py``). Mixed-dtype by
+    construction: any floating x with fp32 (or matching) weight/bias;
+    output dtype follows x. ``memory_efficient`` is accepted for parity —
+    the TPU backward always recomputes statistics (see module docstring).
+    """
+    return _fwd_impl(x, weight, bias, eps, rms=False)
+
+
+def _ln_affine_fwd(x, weight, bias, eps, memory_efficient):
+    return _fwd_impl(x, weight, bias, eps, rms=False), (x, weight)
+
+
+def _ln_affine_bwd(eps, memory_efficient, res, g):
+    x, weight = res
+    dx, dw, db = _bwd_impl(g, x, weight, eps, rms=False)
+    return dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+fused_layer_norm_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rms_norm_affine(x, weight, eps: float = 1e-5,
+                          memory_efficient: bool = True):
+    """RMSNorm with affine transform, Pallas-fused fwd+bwd.
+
+    Reference surface: ``FusedRMSNormAffineFunction`` /
+    ``FusedRMSNormAffineMixedDtypesFunction``."""
+    return _fwd_impl(x, weight, None, eps, rms=True)
+
+
+def _rms_affine_fwd(x, weight, eps, memory_efficient):
+    return _fwd_impl(x, weight, None, eps, rms=True), (x, weight)
+
+
+def _rms_affine_bwd(eps, memory_efficient, res, g):
+    x, weight = res
+    dx, dw, _ = _bwd_impl(g, x, weight, eps, rms=True)
+    return dx, dw.astype(weight.dtype)
+
+
+fused_rms_norm_affine.defvjp(_rms_affine_fwd, _rms_affine_bwd)
+
+
+def fused_layer_norm(x, normalized_shape=None, eps: float = 1e-5):
+    """Elementwise-affine-free LayerNorm (reference: ``fused_layer_norm``)."""
+    h = x.shape[-1]
+    w = jnp.ones((h,), jnp.float32)
+    b = jnp.zeros((h,), jnp.float32)
+    return fused_layer_norm_affine(x, w, b, eps)
+
+
+def fused_rms_norm(x, normalized_shape=None, eps: float = 1e-5):
+    """Affine-free RMSNorm (reference: ``fused_rms_norm``)."""
+    h = x.shape[-1]
+    w = jnp.ones((h,), jnp.float32)
+    return fused_rms_norm_affine(x, w, eps)
+
+
+# Pure-jnp references (used by tests and as a documented fallback).
+
+def layer_norm_reference(x, weight, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rms_norm_reference(x, weight, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    ms = (xf * xf).mean(-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps) * weight.astype(jnp.float32)
+    return y.astype(x.dtype)

@@ -1,0 +1,409 @@
+"""Fused multi-tensor ops — the TPU-native ``amp_C`` kernel set.
+
+Rebuild of the reference's ``csrc/multi_tensor_*.cu`` family (SURVEY.md
+§2.2): one fused pass over *lists* of tensors for scaling/unscaling with
+inf/nan detection, L2 norms, and every optimizer update.
+
+TPU design: instead of chunking device pointers into kernel-arg structs
+(the CUDA ``multi_tensor_apply.cuh`` mechanism: ≤36 tensor addrs per
+launch, 320 blocks), each parallel tensor-list is raveled into ONE
+contiguous fp32 working buffer and the whole elementwise update chain runs
+as a single XLA fusion over it. That is the TPU analog of apex's
+one-launch-per-chunk property: O(1) dispatches per step regardless of the
+number of parameter tensors, HBM-bandwidth-bound, MXU-free.
+
+Per-tensor semantics (LAMB trust ratios, NovoGrad per-layer moments) use
+per-leaf reductions; XLA concatenates these small reductions into a
+handful of fusions.
+
+Op signatures follow the reference convention
+``op(chunk_size, noop_flag, tensor_lists, *args)`` so
+``multi_tensor_applier`` call sites port verbatim. ``noop_flag`` is a
+traced bool (or None): when truthy, outputs are the unmodified inputs —
+the functional translation of the CUDA kernels' early-exit on
+``*noop_flag != 0``. Ops that detect non-finite values return an updated
+flag.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import ravel_list, unravel_list
+
+Array = jax.Array
+
+
+def _fuse(tensors: Sequence[Array]):
+    """Ravel a tensor list into one fp32 working buffer + metadata."""
+    flat, meta = ravel_list(tensors)
+    return flat.astype(jnp.float32), meta
+
+
+def _split(flat: Array, meta):
+    """Split a working buffer back into leaf shapes WITHOUT casting: the
+    fp32 working precision must survive until the final per-output cast
+    (a premature cast through a low-precision input dtype would round away
+    master-weight updates)."""
+    out = []
+    offset = 0
+    for shape, _dtype, size in meta:
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
+        offset += size
+    return out
+
+
+def _apply_noop(noop_flag, new_lists, old_lists):
+    if noop_flag is None:
+        return new_lists
+    return [
+        [jnp.where(noop_flag, o, n) for n, o in zip(new, old)]
+        for new, old in zip(new_lists, old_lists)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# scale / axpby / l2norm  (csrc/multi_tensor_{scale,axpby,l2norm}.cu)
+# ---------------------------------------------------------------------------
+
+def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
+    """out = in * scale, detecting non-finite values in one fused pass.
+
+    Reference: ``amp_C.multi_tensor_scale`` — the hot op of loss unscaling
+    (SURVEY.md §3.2). Returns ``(out_list, noop_flag_out)``.
+    """
+    (src,), out_dtypes = (tensor_lists[0],), [t.dtype for t in tensor_lists[-1]]
+    flat, meta = _fuse(src)
+    scaled = flat * jnp.float32(scale)
+    found = jnp.logical_not(jnp.all(jnp.isfinite(scaled)))
+    flag_out = found if noop_flag is None else jnp.logical_or(noop_flag, found)
+    outs = [o.astype(d) for o, d in zip(_split(scaled, meta), out_dtypes)]
+    if noop_flag is not None:
+        outs = [jnp.where(noop_flag, s.astype(d), o)
+                for s, o, d in zip(src, outs, out_dtypes)]
+    return outs, flag_out
+
+
+def multi_tensor_axpby(chunk_size, noop_flag, tensor_lists, a, b):
+    """out = a*x + b*y over parallel lists (``amp_C.multi_tensor_axpby``)."""
+    x_list, y_list = tensor_lists[0], tensor_lists[1]
+    out_dtypes = [t.dtype for t in tensor_lists[-1]]
+    fx, meta = _fuse(x_list)
+    fy, _ = _fuse(y_list)
+    out = jnp.float32(a) * fx + jnp.float32(b) * fy
+    found = jnp.logical_not(jnp.all(jnp.isfinite(out)))
+    flag_out = found if noop_flag is None else jnp.logical_or(noop_flag, found)
+    outs = [o.astype(d) for o, d in zip(_split(out, meta), out_dtypes)]
+    (outs,) = _apply_noop(noop_flag, [outs], [tensor_lists[-1]])
+    return outs, flag_out
+
+
+def multi_tensor_l2norm(chunk_size, noop_flag, tensor_lists, per_tensor=False):
+    """L2 norms: global and optionally per-tensor
+    (``amp_C.multi_tensor_l2norm``; feeds LAMB stage 1 and clip_grad).
+
+    Per-tensor squared norms are small per-leaf reductions; the global norm
+    is their sum — all fused by XLA into one pass over the flat data.
+    """
+    tensors = tensor_lists[0]
+    sq = jnp.stack([jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors])
+    global_norm = jnp.sqrt(jnp.sum(sq))
+    if per_tensor:
+        return global_norm, jnp.sqrt(sq)
+    return global_norm, None
+
+
+# ---------------------------------------------------------------------------
+# Adam / Adagrad  (csrc/multi_tensor_adam.cu, multi_tensor_adagrad.cu)
+# ---------------------------------------------------------------------------
+
+ADAM_MODE_L2 = 0       # classic Adam: wd folded into the gradient
+ADAM_MODE_ADAMW = 1    # decoupled weight decay
+
+
+def multi_tensor_adam(
+    chunk_size,
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    mode,
+    bias_correction,
+    weight_decay,
+):
+    """Fused Adam/AdamW update over [grads, params, exp_avg, exp_avg_sq]
+    (+ optional trailing fp32 master-param list, mirroring the reference's
+    ``master_weights`` variant).
+
+    Returns ``([new_params, new_m, new_v] (+ [new_master]), )`` in fp32
+    working precision cast back to the input dtypes.
+    """
+    has_master = len(tensor_lists) == 5
+    g_list, p_list, m_list, v_list = tensor_lists[:4]
+    master_list = tensor_lists[4] if has_master else None
+
+    g, meta = _fuse(g_list)
+    # With master weights, the fp32 master buffer is the source of truth.
+    p, _ = _fuse(master_list if has_master else p_list)
+    m, _ = _fuse(m_list)
+    v, _ = _fuse(v_list)
+
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+
+    if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+        g = g + weight_decay * p
+
+    m = beta1 * m + (1.0 - beta1) * g
+    v = beta2 * v + (1.0 - beta2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
+        update = update + weight_decay * p
+    p_new = p - lr * update
+
+    def cast_like(flat, ref_list):
+        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
+
+    new_p = cast_like(p_new, p_list)
+    new_m = cast_like(m, m_list)
+    new_v = cast_like(v, v_list)
+    old = [p_list, m_list, v_list]
+    new = [new_p, new_m, new_v]
+    if has_master:
+        new.append(cast_like(p_new, master_list))
+        old.append(master_list)
+    return _apply_noop(noop_flag, new, old)
+
+
+def multi_tensor_adagrad(chunk_size, noop_flag, tensor_lists, lr, eps, mode, weight_decay):
+    """Fused Adagrad over [grads, params, state_sums]
+    (+ optional trailing fp32 master-param list)
+    (``amp_C.multi_tensor_adagrad``)."""
+    has_master = len(tensor_lists) == 4
+    g_list, p_list, h_list = tensor_lists[:3]
+    master_list = tensor_lists[3] if has_master else None
+    g, meta = _fuse(g_list)
+    p, _ = _fuse(master_list if has_master else p_list)
+    h, _ = _fuse(h_list)
+    if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+        g = g + weight_decay * p
+    h = h + g * g
+    p_new = p - lr * g / (jnp.sqrt(h) + eps)
+    if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
+        p_new = p_new - lr * weight_decay * p
+
+    def cast_like(flat, ref_list):
+        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
+
+    new = [cast_like(p_new, p_list), cast_like(h, h_list)]
+    old = [p_list, h_list]
+    if has_master:
+        new.append(cast_like(p_new, master_list))
+        old.append(master_list)
+    return _apply_noop(noop_flag, new, old)
+
+
+# ---------------------------------------------------------------------------
+# SGD  (csrc/multi_tensor_sgd_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def multi_tensor_sgd(
+    chunk_size,
+    noop_flag,
+    tensor_lists,
+    weight_decay,
+    momentum,
+    dampening,
+    lr,
+    nesterov,
+    first_run,
+    wd_after_momentum,
+    scale=1.0,
+):
+    """Fused SGD over [grads, params, momentum_buffers]
+    (+ optional trailing fp32 master-param list).
+
+    Mirrors the reference kernel's knobs: nesterov, dampening,
+    wd_after_momentum, grad pre-scale, and first_run momentum init.
+    """
+    has_master = len(tensor_lists) == 4
+    g_list, p_list, mom_list = tensor_lists[:3]
+    master_list = tensor_lists[3] if has_master else None
+
+    g, meta = _fuse(g_list)
+    p, _ = _fuse(master_list if has_master else p_list)
+    mom, _ = _fuse(mom_list)
+
+    g = g * jnp.float32(scale)
+    if weight_decay != 0.0 and not wd_after_momentum:
+        g = g + weight_decay * p
+
+    if momentum != 0.0:
+        mom_new = jnp.where(jnp.bool_(first_run), g, momentum * mom + (1.0 - dampening) * g)
+        d = g + momentum * mom_new if nesterov else mom_new
+    else:
+        mom_new = mom
+        d = g
+
+    if weight_decay != 0.0 and wd_after_momentum:
+        d = d + weight_decay * p
+
+    p_new = p - lr * d
+
+    def cast_like(flat, ref_list):
+        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
+
+    new = [cast_like(p_new, p_list), cast_like(mom_new, mom_list)]
+    old = [p_list, mom_list]
+    if has_master:
+        new.append(cast_like(p_new, master_list))
+        old.append(master_list)
+    return _apply_noop(noop_flag, new, old)
+
+
+# ---------------------------------------------------------------------------
+# LAMB  (csrc/multi_tensor_lamb.cu + lamb_stage_1/2)
+# ---------------------------------------------------------------------------
+
+def multi_tensor_lamb_stage1(
+    chunk_size, noop_flag, tensor_lists, beta1, beta2, eps, step,
+    bias_correction, weight_decay, grad_averaging, global_grad_norm,
+    max_global_grad_norm,
+):
+    """LAMB stage 1 (``multi_tensor_lamb_stage_1``): clip by global grad
+    norm, update moments, produce per-tensor update directions.
+
+    Returns ``(update_list, new_m_list, new_v_list)`` in fp32.
+    """
+    g_list, p_list, m_list, v_list = tensor_lists
+
+    clip = jnp.where(
+        global_grad_norm > max_global_grad_norm,
+        max_global_grad_norm / global_grad_norm,
+        1.0,
+    ) if max_global_grad_norm > 0 else jnp.float32(1.0)
+
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    g, meta = _fuse(g_list)
+    p, _ = _fuse(p_list)
+    m, _ = _fuse(m_list)
+    v, _ = _fuse(v_list)
+
+    g = g * clip
+    m = beta1 * m + beta3 * g
+    v = beta2 * v + (1.0 - beta2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if weight_decay != 0.0:
+        update = update + weight_decay * p
+
+    return _split(update, meta), _split(m, meta), _split(v, meta)
+
+
+def multi_tensor_lamb_stage2(
+    chunk_size, noop_flag, tensor_lists, lr, weight_decay=0.0, use_nvlamb=False,
+):
+    """LAMB stage 2 (``multi_tensor_lamb_stage_2``): per-tensor trust
+    ratios from ||p|| / ||update||, then the parameter step.
+
+    Reference semantics: the trust ratio is applied only when the tensor is
+    weight-decayed or ``use_nvlamb`` is set; otherwise the step is a plain
+    Adam step (ratio 1) — NVLAMB applies the ratio unconditionally.
+
+    tensor_lists = [params, updates] (+ optional fp32 master list).
+    """
+    has_master = len(tensor_lists) == 3
+    p_list, u_list = tensor_lists[:2]
+    master_list = tensor_lists[2] if has_master else None
+    src_list = master_list if has_master else p_list
+    apply_ratio = use_nvlamb or weight_decay != 0.0
+
+    new_p, new_master = [], []
+    for i, (p, u) in enumerate(zip(src_list, u_list)):
+        p32 = p.astype(jnp.float32)
+        u32 = u.astype(jnp.float32)
+        if apply_ratio:
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(u32)))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.float32(1.0)
+            )
+        else:
+            ratio = jnp.float32(1.0)
+        stepped = p32 - lr * ratio * u32
+        new_p.append(stepped.astype(p_list[i].dtype))
+        if has_master:
+            new_master.append(stepped)
+    if has_master:
+        return new_p, new_master
+    return new_p
+
+
+def multi_tensor_novograd(
+    chunk_size, noop_flag, tensor_lists, lr, beta1, beta2, eps, step,
+    bias_correction, weight_decay, grad_averaging, norm_type,
+    init_zero=False,
+):
+    """Fused NovoGrad over [grads, params, exp_avg] with per-tensor second
+    moments (``amp_C.multi_tensor_novograd``; v is a scalar per tensor).
+
+    tensor_lists = [grads, params, exp_avg, v (+ optional master list)];
+    ``v`` (per-tensor second moments) is a stacked vector. ``init_zero``
+    selects the reference's v-initialization: True applies the EMA formula
+    from a zero v at step 1 (larger first steps), False (default) seeds v
+    with the first step's squared gradient norms.
+    Returns ``(new_params, new_m, new_v[, new_master])``.
+    """
+    has_master = len(tensor_lists) == 5
+    g_list, p_list, m_list = tensor_lists[:3]
+    v = tensor_lists[3]  # stacked per-tensor second moments, shape (n,)
+    master_list = tensor_lists[4] if has_master else None
+    src_list = master_list if has_master else p_list
+
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    g_norms = jnp.stack(
+        [jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))) for g in g_list]
+    )
+    ema = beta2 * v + (1.0 - beta2) * g_norms ** 2
+    if init_zero:
+        v_new = ema
+    else:
+        v_new = jnp.where(jnp.bool_(step == 1), g_norms ** 2, ema)
+    denom = jnp.sqrt(v_new / bc2) + eps
+
+    new_p, new_m, new_master = [], [], []
+    for i, (g, p, m) in enumerate(zip(g_list, src_list, m_list)):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32) / denom[i]
+        if weight_decay != 0.0:
+            g32 = g32 + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+        upd = m32 / bc1
+        stepped = p32 - lr * upd
+        new_p.append(stepped.astype(p_list[i].dtype))
+        new_m.append(m32.astype(m.dtype))
+        if has_master:
+            new_master.append(stepped.astype(master_list[i].dtype))
+    if has_master:
+        return new_p, new_m, v_new, new_master
+    return new_p, new_m, v_new

@@ -1,0 +1,12 @@
+"""apex_tpu.optimizers — fused optimizers (SURVEY.md §2.1 L3).
+
+Each optimizer's whole update runs as one XLA flat-buffer fusion via
+``multi_tensor_applier`` (see apex_tpu.ops.multi_tensor), mirroring the
+reference's one-kernel-launch property on TPU.
+"""
+
+from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, NovoGradState  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState  # noqa: F401

@@ -1,0 +1,94 @@
+"""Shared machinery for the fused optimizers.
+
+The reference optimizers (``apex/optimizers/*``, SURVEY.md §2.1) are
+torch ``Optimizer`` subclasses whose ``step()`` makes one
+``multi_tensor_applier`` call. The rebuild keeps that shape as a
+functional core: each optimizer is an immutable config object with
+
+- ``init(params) -> state``   (state is a pytree: step count + moments
+  [+ fp32 master params when ``master_weights``])
+- ``step(grads, state, params, skip_if=None, lr=None) -> (params, state)``
+
+``skip_if`` is the amp overflow flag: when True the returned params/state
+are the inputs unchanged and the step counter does not advance —
+the in-graph equivalent of apex's patched ``optimizer.step()`` no-op on
+overflow (SURVEY.md §3.2). ``as_optax()`` adapts any of these to an
+``optax.GradientTransformation`` for idiomatic JAX training loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_cast, tree_select
+
+
+def leaves_of(tree):
+    return jax.tree.leaves(tree)
+
+
+def like_tree(leaves, tree):
+    return jax.tree.unflatten(jax.tree.structure(tree), leaves)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedOptimizer:
+    """Base class: config dataclass + functional init/step."""
+
+    lr: float = 1e-3
+    weight_decay: float = 0.0
+    master_weights: bool = False
+
+    def with_master_weights(self, flag: bool = True):
+        """Return a copy with fp32 master weights enabled (used by
+        ``amp.initialize`` for O2, reference ``_process_optimizer``)."""
+        return dataclasses.replace(self, master_weights=flag)
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    # subclasses implement init() and step()
+
+    def _master_init(self, params):
+        if not self.master_weights:
+            return None
+        return tree_cast(params, jnp.float32)
+
+    def _finish_step(self, skip_if, new_params, new_state, params, state):
+        """Apply the overflow step-skip select (params, moments, AND the
+        step counter stay untouched on skip)."""
+        if skip_if is None:
+            return new_params, new_state
+        out_p = tree_select(skip_if, params, new_params)
+        out_s = tree_select(skip_if, state, new_state)
+        return out_p, out_s
+
+    def as_optax(self):
+        """Adapt to an ``optax.GradientTransformation``.
+
+        The transformation's update returns ``new_params - params`` so it
+        composes with ``optax.apply_updates``. Requires params.
+        """
+        import optax
+
+        opt = self
+
+        def init_fn(params):
+            return opt.init(params)
+
+        def update_fn(grads, state, params=None):
+            if params is None:
+                raise ValueError(f"{type(opt).__name__}.as_optax() requires params")
+            new_params, new_state = opt.step(grads, state, params)
+            updates = jax.tree.map(
+                lambda n, p: (n.astype(jnp.float32) - p.astype(jnp.float32)).astype(p.dtype),
+                new_params,
+                params,
+            )
+            return updates, new_state
+
+        return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,69 @@
+"""FusedAdagrad — fused Adagrad.
+
+Rebuild of ``apex/optimizers/fused_adagrad.py`` +
+``csrc/multi_tensor_adagrad.cu`` (SURVEY.md §2.1). Knob parity: ``lr``,
+``eps``, ``weight_decay``, ``adagrad_w_mode`` (decoupled weight decay).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import (
+    ADAM_MODE_ADAMW,
+    ADAM_MODE_L2,
+    multi_tensor_adagrad,
+)
+from apex_tpu.optimizers._base import FusedOptimizer, leaves_of, like_tree
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum: any
+    master: any
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedAdagrad(FusedOptimizer):
+    lr: float = 1e-2
+    eps: float = 1e-10
+    weight_decay: float = 0.0
+    adagrad_w_mode: bool = False
+    set_grad_none: bool = True
+    master_weights: bool = False
+
+    def init(self, params) -> AdagradState:
+        return AdagradState(
+            step=jnp.zeros((), jnp.int32),
+            sum=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            master=self._master_init(params),
+        )
+
+    def step(self, grads, state: AdagradState, params, skip_if=None, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+
+        lists = [leaves_of(grads), leaves_of(params), leaves_of(state.sum)]
+        if self.master_weights:
+            lists.append(leaves_of(state.master))
+        out = multi_tensor_applier(
+            multi_tensor_adagrad,
+            None,
+            lists,
+            lr,
+            self.eps,
+            ADAM_MODE_ADAMW if self.adagrad_w_mode else ADAM_MODE_L2,
+            self.weight_decay,
+        )
+        new_p = like_tree(out[0], params)
+        new_state = AdagradState(
+            step=step,
+            sum=like_tree(out[1], state.sum),
+            master=like_tree(out[2], state.master) if self.master_weights else None,
+        )
+        return self._finish_step(skip_if, new_p, new_state, params, state)

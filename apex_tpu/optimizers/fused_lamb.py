@@ -1,0 +1,121 @@
+"""FusedLAMB — two-stage fused LAMB (the BERT-large north-star optimizer).
+
+Rebuild of ``apex/optimizers/fused_lamb.py`` (SURVEY.md §3.3): stage 1
+computes the global gradient norm (``multi_tensor_l2norm``), clips, and
+updates moments into per-tensor update directions
+(``multi_tensor_lamb_stage_1``); stage 2 computes per-tensor trust ratios
+``||p|| / ||update||`` and applies the step
+(``multi_tensor_lamb_stage_2``). Knob parity: ``bias_correction``,
+``betas``, ``eps``, ``weight_decay``, ``grad_averaging``,
+``max_grad_norm``, ``adam_w_mode``, ``use_nvlamb``, ``master_weights``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_l2norm,
+    multi_tensor_lamb_stage1,
+    multi_tensor_lamb_stage2,
+)
+from apex_tpu.optimizers._base import FusedOptimizer, leaves_of, like_tree
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    master: any
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedLAMB(FusedOptimizer):
+    lr: float = 1e-3
+    bias_correction: bool = True
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-6
+    weight_decay: float = 0.01
+    amsgrad: bool = False
+    adam_w_mode: bool = True
+    grad_averaging: bool = True
+    set_grad_none: bool = True
+    max_grad_norm: float = 1.0
+    use_nvlamb: bool = False
+    master_weights: bool = False
+
+    def __post_init__(self):
+        if self.amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        if not self.adam_w_mode:
+            raise RuntimeError(
+                "FusedLAMB only supports adam_w_mode (decoupled weight decay), "
+                "matching the reference kernel."
+            )
+
+    def init(self, params) -> LambState:
+        return LambState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            master=self._master_init(params),
+        )
+
+    def step(self, grads, state: LambState, params, skip_if=None, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+
+        g = leaves_of(grads)
+        p_model = leaves_of(params)
+        p_src = leaves_of(state.master) if self.master_weights else p_model
+        m = leaves_of(state.exp_avg)
+        v = leaves_of(state.exp_avg_sq)
+
+        # Stage 0: global grad norm (one fused reduction pass).
+        global_norm, _ = multi_tensor_applier(
+            multi_tensor_l2norm, None, [g], False
+        )
+
+        # Stage 1: clip + moments + update directions.
+        updates, new_m, new_v = multi_tensor_applier(
+            multi_tensor_lamb_stage1,
+            None,
+            [g, p_src, m, v],
+            self.betas[0],
+            self.betas[1],
+            self.eps,
+            step,
+            self.bias_correction,
+            self.weight_decay,
+            self.grad_averaging,
+            global_norm,
+            self.max_grad_norm,
+        )
+
+        # Stage 2: per-tensor trust ratios + parameter step.
+        lists = [p_model, updates]
+        if self.master_weights:
+            lists.append(p_src)
+        out = multi_tensor_applier(
+            multi_tensor_lamb_stage2, None, lists, lr, self.weight_decay,
+            self.use_nvlamb,
+        )
+        if self.master_weights:
+            new_p_leaves, new_master_leaves = out
+            new_master = like_tree(new_master_leaves, state.master)
+        else:
+            new_p_leaves, new_master = out, None
+
+        new_p = like_tree(new_p_leaves, params)
+        new_state = LambState(
+            step=step,
+            exp_avg=like_tree(new_m, state.exp_avg),
+            exp_avg_sq=like_tree(new_v, state.exp_avg_sq),
+            master=new_master,
+        )
+        return self._finish_step(skip_if, new_p, new_state, params, state)

@@ -1,0 +1,95 @@
+"""FusedNovoGrad — fused NovoGrad with per-tensor second moments.
+
+Rebuild of ``apex/optimizers/fused_novograd.py`` +
+``csrc/multi_tensor_novograd.cu`` (SURVEY.md §2.1): the second moment is a
+scalar per tensor (the squared-gradient L2 norm EMA), normalizing each
+layer's gradient before the first-moment EMA. Knob parity:
+``bias_correction``, ``betas``, ``eps``, ``weight_decay``,
+``grad_averaging``, ``norm_type`` (2 only, like the reference kernel),
+``init_zero``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import multi_tensor_novograd
+from apex_tpu.optimizers._base import FusedOptimizer, leaves_of, like_tree
+
+
+class NovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: jnp.ndarray  # stacked per-tensor scalars, shape (n_tensors,)
+    master: any
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedNovoGrad(FusedOptimizer):
+    lr: float = 1e-3
+    bias_correction: bool = True
+    betas: Tuple[float, float] = (0.95, 0.98)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    amsgrad: bool = False
+    reg_inside_moment: bool = False
+    grad_averaging: bool = True
+    norm_type: int = 2
+    init_zero: bool = False
+    set_grad_none: bool = True
+    master_weights: bool = False
+
+    def __post_init__(self):
+        if self.amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        if self.norm_type != 2:
+            raise RuntimeError("FusedNovoGrad only supports the L2 norm_type, like the reference kernel.")
+
+    def init(self, params) -> NovoGradState:
+        n = len(leaves_of(params))
+        return NovoGradState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            exp_avg_sq=jnp.zeros((n,), jnp.float32),
+            master=self._master_init(params),
+        )
+
+    def step(self, grads, state: NovoGradState, params, skip_if=None, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+
+        g = leaves_of(grads)
+        p = leaves_of(params)
+        m = leaves_of(state.exp_avg)
+        lists = [g, p, m, state.exp_avg_sq]
+        if self.master_weights:
+            lists.append(leaves_of(state.master))
+
+        out = multi_tensor_applier(
+            multi_tensor_novograd,
+            None,
+            lists,
+            lr,
+            self.betas[0],
+            self.betas[1],
+            self.eps,
+            step,
+            self.bias_correction,
+            self.weight_decay,
+            self.grad_averaging,
+            self.norm_type,
+            self.init_zero,
+        )
+        new_p = like_tree(out[0], params)
+        new_state = NovoGradState(
+            step=step,
+            exp_avg=like_tree(out[1], state.exp_avg),
+            exp_avg_sq=out[2],
+            master=like_tree(out[3], state.master) if self.master_weights else None,
+        )
+        return self._finish_step(skip_if, new_p, new_state, params, state)

@@ -1,0 +1,85 @@
+"""FusedSGD — fused momentum SGD.
+
+Rebuild of ``apex/optimizers/fused_sgd.py`` + ``csrc/multi_tensor_sgd_kernel.cu``
+(SURVEY.md §2.1): params/momentum for every tensor updated in one
+flat-buffer fusion. Knob parity: ``momentum``, ``dampening``, ``nesterov``
+(with the reference's validity check), ``weight_decay``,
+``wd_after_momentum``, ``materialize_master_grads`` (parity no-op: grads
+are always materialized inputs here), ``master_weights``, and the
+``scale`` pre-factor used by amp integration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import multi_tensor_sgd
+from apex_tpu.optimizers._base import FusedOptimizer, leaves_of, like_tree
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buffer: any
+    master: any
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedSGD(FusedOptimizer):
+    lr: float = 1e-3  # reference requires lr; keep a sane default
+    momentum: float = 0.0
+    dampening: float = 0.0
+    weight_decay: float = 0.0
+    nesterov: bool = False
+    wd_after_momentum: bool = False
+    materialize_master_grads: bool = True
+    master_weights: bool = False
+
+    def __post_init__(self):
+        if self.nesterov and (self.momentum <= 0 or self.dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init(self, params) -> SGDState:
+        return SGDState(
+            step=jnp.zeros((), jnp.int32),
+            momentum_buffer=jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+            master=self._master_init(params),
+        )
+
+    def step(self, grads, state: SGDState, params, skip_if=None, lr=None, scale=1.0):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+
+        g = leaves_of(grads)
+        p = leaves_of(params)
+        mom = leaves_of(state.momentum_buffer)
+        lists = [g, p, mom]
+        if self.master_weights:
+            lists.append(leaves_of(state.master))
+
+        out = multi_tensor_applier(
+            multi_tensor_sgd,
+            None,
+            lists,
+            self.weight_decay,
+            self.momentum,
+            self.dampening,
+            lr,
+            self.nesterov,
+            state.step == 0,  # first_run: momentum buffer takes the raw grad
+            self.wd_after_momentum,
+            scale,
+        )
+        new_p = like_tree(out[0], params)
+        new_state = SGDState(
+            step=step,
+            momentum_buffer=like_tree(out[1], state.momentum_buffer),
+            master=like_tree(out[2], state.master) if self.master_weights else None,
+        )
+        return self._finish_step(skip_if, new_p, new_state, params, state)

@@ -1,0 +1,282 @@
+"""Fused optimizer tests vs independent references (upstream analog:
+tests/L0/run_optimizers/test_fused_optimizer.py and test_lamb.py —
+FusedAdam vs torch.optim.Adam, FusedLAMB vs an in-test reference LAMB;
+here the references are optax and hand-rolled numpy, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import (
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedNovoGrad,
+    FusedSGD,
+)
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "layer1": {"kernel": jnp.asarray(rng.randn(8, 8).astype("float32")),
+                   "bias": jnp.asarray(rng.randn(8).astype("float32"))},
+        "layer2": {"kernel": jnp.asarray(rng.randn(8, 4).astype("float32"))},
+    }
+
+
+def _grads(seed=1):
+    return _params(seed)
+
+
+def test_fused_adam_matches_optax_adamw():
+    params = _params()
+    grads = _grads()
+    opt = FusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=True)
+    st = opt.init(params)
+
+    ref = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    ref_st = ref.init(params)
+    ref_params = params
+
+    p = params
+    for _ in range(5):
+        p, st = opt.step(grads, st, p)
+        upd, ref_st = ref.update(grads, ref_st, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_fused_adam_l2_mode_matches_optax_adam_with_l2():
+    params = _params()
+    grads = _grads()
+    opt = FusedAdam(lr=1e-2, weight_decay=0.1, adam_w_mode=False)
+    st = opt.init(params)
+    p, st = opt.step(grads, st, p if (p := params) is not None else params)
+
+    # reference: grad + wd*param into plain adam
+    ref = optax.adam(1e-2)
+    ref_st = ref.init(params)
+    g2 = jax.tree.map(lambda g, q: g + 0.1 * q, grads, params)
+    upd, _ = ref.update(g2, ref_st, params)
+    ref_p = optax.apply_updates(params, upd)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_fused_adam_amsgrad_raises():
+    with pytest.raises(RuntimeError):
+        FusedAdam(amsgrad=True)
+
+
+def test_fused_adam_skip_if_freezes_everything():
+    params = _params()
+    grads = _grads()
+    opt = FusedAdam(lr=1e-2)
+    st = opt.init(params)
+    p2, st2 = opt.step(grads, st, params, skip_if=jnp.asarray(True))
+    assert int(st2.step) == 0  # step count does not advance on skip
+    for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_adam_master_weights_bf16():
+    """O2 flow: bf16 model params, fp32 masters carried in optimizer state.
+    Master accumulates small updates that bf16 alone would lose."""
+    params = {"w": jnp.ones((64,), jnp.bfloat16)}
+    opt = FusedAdam(lr=1e-5).with_master_weights()
+    st = opt.init(params)
+    assert st.master["w"].dtype == jnp.float32
+    grads = {"w": jnp.full((64,), 1.0, jnp.bfloat16)}
+    p = params
+    for _ in range(3):
+        p, st = opt.step(grads, st, p)
+    assert p["w"].dtype == jnp.bfloat16
+    assert float(st.master["w"][0]) < 1.0  # master moved at fp32 resolution
+
+
+def test_fused_lamb_matches_reference_lamb():
+    """Hand-rolled reference LAMB (the upstream test_lamb.py pattern)."""
+    params = _params()
+    grads = _grads()
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.01
+    opt = FusedLAMB(lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd,
+                    max_grad_norm=0.0)  # no clipping for the simple ref
+    st = opt.init(params)
+    p, st = opt.step(grads, st, params)
+
+    # reference
+    leaves_p = [np.asarray(x) for x in jax.tree.leaves(params)]
+    leaves_g = [np.asarray(x) for x in jax.tree.leaves(grads)]
+    out = []
+    for q, g in zip(leaves_p, leaves_g):
+        m = (1 - b1) * g
+        v = (1 - b2) * g * g
+        bc1, bc2 = 1 - b1, 1 - b2
+        upd = (m / bc1) / (np.sqrt(v / bc2) + eps) + wd * q
+        w_norm = np.linalg.norm(q)
+        u_norm = np.linalg.norm(upd)
+        ratio = w_norm / u_norm if (w_norm > 0 and u_norm > 0) else 1.0
+        out.append(q - lr * ratio * upd)
+
+    for a, b in zip(jax.tree.leaves(p), out):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_lamb_grad_clipping_engages():
+    params = {"w": jnp.ones((4,))}
+    big = {"w": jnp.full((4,), 1000.0)}
+    opt = FusedLAMB(lr=1e-2, max_grad_norm=1.0, weight_decay=0.0)
+    st = opt.init(params)
+    p_clip, _ = opt.step(big, st, params)
+    opt_noclip = opt.replace(max_grad_norm=0.0)
+    p_noclip, _ = opt_noclip.step(big, opt_noclip.init(params), params)
+    # same direction; clipped ratio identical here due to trust ratio
+    # normalization, but moments must differ
+    assert np.isfinite(np.asarray(p_clip["w"])).all()
+    assert np.isfinite(np.asarray(p_noclip["w"])).all()
+
+
+def test_fused_lamb_no_decay_is_plain_adam_step():
+    """Reference semantics: without weight decay (and without use_nvlamb)
+    the trust ratio is NOT applied."""
+    params = {"w": jnp.full((4,), 10.0)}
+    grads = {"w": jnp.full((4,), 1.0)}
+    lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-6
+    opt = FusedLAMB(lr=lr, betas=(b1, b2), eps=eps, weight_decay=0.0,
+                    max_grad_norm=0.0)
+    p, _ = opt.step(grads, opt.init(params), params)
+    # plain adam first step: update ~= 1 (m/bc1)/(sqrt(v/bc2)+eps)
+    upd = ((1 - b1) / (1 - b1)) / (np.sqrt(1.0) + eps)
+    np.testing.assert_allclose(np.asarray(p["w"]), 10.0 - lr * upd, rtol=1e-5)
+
+
+def test_fused_lamb_nvlamb_applies_ratio_without_decay():
+    params = {"w": jnp.full((4,), 10.0)}
+    grads = {"w": jnp.full((4,), 1.0)}
+    opt = FusedLAMB(lr=1e-2, weight_decay=0.0, max_grad_norm=0.0, use_nvlamb=True)
+    p, _ = opt.step(grads, opt.init(params), params)
+    p_ref, _ = opt.replace(use_nvlamb=False).step(grads, opt.init(params), params)
+    assert not np.allclose(np.asarray(p["w"]), np.asarray(p_ref["w"]))
+
+
+def test_adagrad_and_novograd_master_weights_update():
+    """Masters must actually move under O2 (review regression)."""
+    from apex_tpu.optimizers import FusedAdagrad, FusedNovoGrad
+
+    params = {"w": jnp.ones((64,), jnp.bfloat16)}
+    grads = {"w": jnp.full((64,), 0.01, jnp.bfloat16)}
+    for opt in (FusedAdagrad(lr=1e-5).with_master_weights(),
+                FusedNovoGrad(lr=1e-5).with_master_weights()):
+        st = opt.init(params)
+        p, st = opt.step(grads, st, params)
+        assert st.master["w"].dtype == jnp.float32
+        assert float(st.master["w"][0]) != 1.0, type(opt).__name__
+        assert p["w"].dtype == jnp.bfloat16
+
+
+def test_novograd_init_zero_changes_first_step():
+    from apex_tpu.optimizers import FusedNovoGrad
+
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.full((4,), 2.0)}
+    a = FusedNovoGrad(lr=0.1, init_zero=False, bias_correction=False)
+    b = FusedNovoGrad(lr=0.1, init_zero=True, bias_correction=False)
+    pa, _ = a.step(grads, a.init(params), params)
+    pb, _ = b.step(grads, b.init(params), params)
+    assert not np.allclose(np.asarray(pa["w"]), np.asarray(pb["w"]))
+
+
+def test_fused_sgd_matches_optax_sgd_momentum():
+    params = _params()
+    grads = _grads()
+    opt = FusedSGD(lr=0.1, momentum=0.9)
+    st = opt.init(params)
+    ref = optax.sgd(0.1, momentum=0.9)
+    ref_st = ref.init(params)
+
+    p, ref_p = params, params
+    for _ in range(4):
+        p, st = opt.step(grads, st, p)
+        upd, ref_st = ref.update(grads, ref_st, ref_p)
+        ref_p = optax.apply_updates(ref_p, upd)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_sgd_nesterov_validation():
+    with pytest.raises(ValueError):
+        FusedSGD(lr=0.1, nesterov=True, momentum=0.0)
+
+
+def test_fused_adagrad_matches_reference():
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(16).astype("float32"))}
+    grads = {"w": jnp.asarray(np.random.RandomState(1).randn(16).astype("float32"))}
+    opt = FusedAdagrad(lr=0.1, eps=1e-10)
+    st = opt.init(params)
+    p, st = opt.step(grads, st, params)
+
+    h = np.asarray(grads["w"]) ** 2
+    ref = np.asarray(params["w"]) - 0.1 * np.asarray(grads["w"]) / (np.sqrt(h) + 1e-10)
+    np.testing.assert_allclose(np.asarray(p["w"]), ref, rtol=1e-5)
+
+
+def test_fused_novograd_first_step_normalizes_by_grad_norm():
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.full((4,), 2.0)}
+    opt = FusedNovoGrad(lr=0.1, betas=(0.95, 0.98), weight_decay=0.0,
+                        bias_correction=False)
+    st = opt.init(params)
+    p, st = opt.step(grads, st, params)
+    # step 1: v = ||g||^2 = 16, denom = 4; g/denom = 0.5; m = beta3*g' = .05*0.5
+    gnorm = 4.0
+    m = (1 - 0.95) * (2.0 / gnorm)
+    ref = 1.0 - 0.1 * m
+    np.testing.assert_allclose(np.asarray(p["w"]), np.full((4,), ref), rtol=1e-5)
+    np.testing.assert_allclose(float(st.exp_avg_sq[0]), 16.0, rtol=1e-5)
+
+
+def test_as_optax_adapter():
+    params = _params()
+    grads = _grads()
+    tx = FusedAdam(lr=1e-2).as_optax()
+    st = tx.init(params)
+    upd, st = tx.update(grads, st, params)
+    p = optax.apply_updates(params, upd)
+
+    opt = FusedAdam(lr=1e-2)
+    p_ref, _ = opt.step(grads, opt.init(params), params)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_optimizer_step_inside_jit_with_amp():
+    """Integration: amp scaler + FusedAdam step-skip inside one jit."""
+    import apex_tpu.amp as amp
+
+    params = _params()
+    opt = FusedAdam(lr=1e-2)
+    st = opt.init(params)
+    scaler = amp.LossScaler()
+    sst = scaler.init()
+
+    @jax.jit
+    def step(p, ost, sst, bomb):
+        def loss_fn(q):
+            return sum(jnp.sum(x ** 2) for x in jax.tree.leaves(q)) * bomb
+
+        (loss, found), grads = scaler.value_and_grad(loss_fn, sst)(p)
+        p2, ost2 = opt.step(grads, ost, p, skip_if=found)
+        return p2, ost2, scaler.update(sst, found), loss
+
+    p, st, sst, _ = step(params, st, sst, jnp.asarray(1.0))
+    assert int(st.step) == 1
+    p, st, sst, _ = step(p, st, sst, jnp.asarray(jnp.inf))
+    assert int(st.step) == 1  # skipped
+    assert float(sst.loss_scale) == 2.0 ** 15
+    p, st, sst, _ = step(p, st, sst, jnp.asarray(1.0))
+    assert int(st.step) == 2

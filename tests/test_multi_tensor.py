@@ -1,0 +1,160 @@
+"""multi_tensor op tests (upstream analog: tests/L0/run_optimizers +
+the amp unscale path, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import MultiTensorApply, multi_tensor_applier
+from apex_tpu.ops import multi_tensor as mt
+
+
+def _lists(seed=0, n=5):
+    rng = np.random.RandomState(seed)
+    shapes = [(3, 4), (16,), (2, 2, 2), (1,), (8, 3)][:n]
+    return [jnp.asarray(rng.randn(*s).astype("float32")) for s in shapes]
+
+
+def test_applier_signature_parity():
+    assert multi_tensor_applier.chunk_size == 2048 * 32
+    assert MultiTensorApply.available
+
+
+def test_scale():
+    xs = _lists()
+    outs, flag = multi_tensor_applier(mt.multi_tensor_scale, None, [xs, xs], 0.5)
+    assert not bool(flag)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(x) * 0.5, rtol=1e-6)
+
+
+def test_scale_detects_inf():
+    xs = _lists()
+    xs[2] = xs[2].at[0, 0, 0].set(jnp.inf)
+    _, flag = multi_tensor_applier(mt.multi_tensor_scale, None, [xs, xs], 1.0)
+    assert bool(flag)
+
+
+def test_scale_respects_incoming_noop_flag():
+    xs = _lists()
+    outs, flag = multi_tensor_applier(
+        mt.multi_tensor_scale, jnp.asarray(True), [xs, xs], 0.5
+    )
+    assert bool(flag)
+    for x, o in zip(xs, outs):  # early-exit semantics: untouched
+        np.testing.assert_allclose(np.asarray(o), np.asarray(x))
+
+
+def test_axpby():
+    xs, ys = _lists(0), _lists(1)
+    outs, flag = multi_tensor_applier(
+        mt.multi_tensor_axpby, None, [xs, ys, xs], 2.0, -1.0
+    )
+    assert not bool(flag)
+    for x, y, o in zip(xs, ys, outs):
+        np.testing.assert_allclose(np.asarray(o), 2 * np.asarray(x) - np.asarray(y), rtol=1e-6)
+
+
+def test_axpby_respects_incoming_noop_flag():
+    xs, ys = _lists(0), _lists(1)
+    outs, flag = multi_tensor_applier(
+        mt.multi_tensor_axpby, jnp.asarray(True), [xs, ys, ys], 2.0, -1.0
+    )
+    assert bool(flag)
+    for y, o in zip(ys, outs):  # early-exit: last list (outputs) untouched
+        np.testing.assert_allclose(np.asarray(o), np.asarray(y))
+
+
+def test_l2norm_global_and_per_tensor():
+    xs = _lists()
+    g, per = multi_tensor_applier(mt.multi_tensor_l2norm, None, [xs], True)
+    ref_per = np.array([np.linalg.norm(np.asarray(x)) for x in xs])
+    np.testing.assert_allclose(np.asarray(per), ref_per, rtol=1e-5)
+    np.testing.assert_allclose(float(g), np.sqrt((ref_per ** 2).sum()), rtol=1e-5)
+
+
+def test_adam_matches_reference_loop():
+    """Fused flat-buffer Adam == per-tensor eager reference (the upstream
+    test_fused_optimizer.py pattern)."""
+    rng = np.random.RandomState(3)
+    ps = [jnp.asarray(rng.randn(4, 4).astype("float32")),
+          jnp.asarray(rng.randn(7).astype("float32"))]
+    gs = [jnp.asarray(rng.randn(4, 4).astype("float32")),
+          jnp.asarray(rng.randn(7).astype("float32"))]
+    ms = [jnp.zeros_like(p) for p in ps]
+    vs = [jnp.zeros_like(p) for p in ps]
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.01
+
+    for step in (1, 2, 3):
+        out = multi_tensor_applier(
+            mt.multi_tensor_adam, None, [gs, ps, ms, vs],
+            lr, b1, b2, eps, step, mt.ADAM_MODE_ADAMW, True, wd,
+        )
+        ps, ms, vs = out
+
+    # eager reference
+    rp = [np.asarray(x) for x in
+          [jnp.asarray(rng.randn(0))] ]  # placeholder, rebuilt below
+    rng = np.random.RandomState(3)
+    rp = [rng.randn(4, 4).astype("float32"), rng.randn(7).astype("float32")]
+    rg = [rng.randn(4, 4).astype("float32"), rng.randn(7).astype("float32")]
+    rm = [np.zeros_like(p) for p in rp]
+    rv = [np.zeros_like(p) for p in rp]
+    for step in (1, 2, 3):
+        for i in range(2):
+            bc1 = 1 - b1 ** step
+            bc2 = 1 - b2 ** step
+            rm[i] = b1 * rm[i] + (1 - b1) * rg[i]
+            rv[i] = b2 * rv[i] + (1 - b2) * rg[i] ** 2
+            upd = (rm[i] / bc1) / (np.sqrt(rv[i] / bc2) + eps) + wd * rp[i]
+            rp[i] = rp[i] - lr * upd
+    for a, b in zip(ps, rp):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_first_run():
+    ps = _lists(0, 2)
+    gs = _lists(1, 2)
+    moms = [jnp.zeros_like(p) for p in ps]
+    out = multi_tensor_applier(
+        mt.multi_tensor_sgd, None, [gs, ps, moms],
+        0.0, 0.9, 0.0, 0.1, False, True, False,
+    )
+    new_p, new_mom = out
+    for g, m in zip(gs, new_mom):
+        np.testing.assert_allclose(np.asarray(m), np.asarray(g), rtol=1e-6)
+    for p, g, np_ in zip(ps, gs, new_p):
+        np.testing.assert_allclose(np.asarray(np_), np.asarray(p) - 0.1 * np.asarray(g), rtol=1e-5)
+
+
+def test_mixed_dtype_lists():
+    """bf16 params with fp32 masters: fused op keeps master precision."""
+    ps = [jnp.ones((4,), jnp.bfloat16)]
+    master = [jnp.ones((4,), jnp.float32)]
+    gs = [jnp.full((4,), 0.001, jnp.bfloat16)]
+    ms = [jnp.zeros((4,), jnp.float32)]
+    vs = [jnp.zeros((4,), jnp.float32)]
+    out = multi_tensor_applier(
+        mt.multi_tensor_adam, None, [gs, ps, ms, vs, master],
+        1e-3, 0.9, 0.999, 1e-8, 1, mt.ADAM_MODE_ADAMW, True, 0.0,
+    )
+    new_p, _, _, new_master = out
+    assert new_p[0].dtype == jnp.bfloat16
+    assert new_master[0].dtype == jnp.float32
+    # master moved even though the bf16 cast may round
+    assert float(new_master[0][0]) != 1.0
+
+
+def test_jit_single_fusion():
+    """The whole multi-tensor op must be jittable as one computation."""
+    xs = _lists()
+
+    @jax.jit
+    def f(xs):
+        outs, flag = mt.multi_tensor_scale(2048 * 32, None, [xs, xs], 2.0)
+        return outs, flag
+
+    outs, flag = f(xs)
+    assert not bool(flag)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(xs[0]) * 2, rtol=1e-6)
