@@ -1,0 +1,12 @@
+"""apex_tpu.parallel — distributed data parallel, SyncBatchNorm, LARC
+(SURVEY.md §2.1 L4) on jax.lax collectives over ICI/DCN."""
+
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    flat_dist_call,
+)
+from apex_tpu.parallel.larc import LARC  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)

@@ -1,0 +1,148 @@
+"""DistributedDataParallel-semantics gradient synchronization over ICI/DCN.
+
+Rebuild of ``apex/parallel/distributed.py`` (SURVEY.md §3.4) on XLA
+collectives. The reference registers backward hooks that flatten ready
+gradients into ``message_size``-element buckets and allreduce each bucket
+on a side CUDA stream (NCCL); ``delay_allreduce=True`` instead performs one
+flat-buffer allreduce after the full backward.
+
+TPU mapping: gradient synchronization is a pure function applied to the
+grad pytree inside ``shard_map``/``pmap`` over a named mesh axis.
+``jax.lax.psum`` over ICI replaces NCCL ring-allreduce, and XLA's
+latency-hiding scheduler overlaps collectives with the backward
+computation — the role of apex's side streams and hook-driven eager
+buckets. The knobs keep their reference meaning:
+
+- ``message_size``: bucket size in elements. Buckets are flattened in
+  reverse leaf order (the reference fills buckets in reverse
+  gradient-ready order, which approximates reverse forward order).
+- ``delay_allreduce``: one flat buffer over all gradients (the
+  "flat-buffer path" named in the north star).
+- ``allreduce_always_fp32``: upcast bucket buffers to fp32 for the
+  reduction, cast back after.
+- ``gradient_predivide_factor`` / ``gradient_average``: pre-scale by
+  ``1/predivide`` before the psum and post-scale by
+  ``predivide/world_size`` after (net ``1/world_size`` when averaging) —
+  the reference's overflow-resistant two-stage averaging.
+- ``num_allreduce_streams``: accepted for parity; XLA schedules collective
+  streams itself.
+
+shard_map autodiff note: differentiating wrt a *replicated* (``P()``)
+param pytree inside ``shard_map`` already yields the cross-device SUM of
+per-device gradients — the transpose of the implicit broadcast is a psum
+inserted by autodiff. Such gradients are "unvarying" over the mesh axis
+(empty ``vma``); psum-ing them again would multiply by the world size.
+``allreduce_grads`` therefore inspects each bucket's varying-axes set and
+reduces only device-varying data, then applies the averaging divisor
+either way — so it is correct both for autodiff-produced grads and for
+manually assembled per-device values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.collectives import group_size, psum_groups
+from apex_tpu.utils.pytree import flatten_buckets, ravel_list, unravel_list
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedDataParallel:
+    axis_name: str = "data"
+    message_size: int = 10_000_000
+    delay_allreduce: bool = False
+    allreduce_always_fp32: bool = False
+    gradient_average: bool = True
+    gradient_predivide_factor: float = 1.0
+    num_allreduce_streams: int = 1  # parity knob; XLA owns scheduling
+    retain_allreduce_buffers: bool = False  # parity knob
+    axis_index_groups: Optional[tuple] = None  # subgroup reduction support
+
+    def _is_varying(self, x) -> bool:
+        """True if ``x`` still differs across the mesh axis (needs a psum).
+
+        Autodiff-produced grads wrt replicated params come back already
+        summed (empty vma) — see module docstring."""
+        try:
+            vma = jax.typeof(x).vma
+        except (AttributeError, TypeError):
+            return True  # pmap / older tracer: assume varying
+        return self.axis_name in vma
+
+    def _reduce_flat(self, flat):
+        orig_dtype = flat.dtype
+        needs_psum = self._is_varying(flat)
+        if self.allreduce_always_fp32:
+            flat = flat.astype(jnp.float32)
+        if self.gradient_predivide_factor != 1.0:
+            flat = flat / self.gradient_predivide_factor
+        if needs_psum:
+            flat = psum_groups(flat, self.axis_name, self.axis_index_groups)
+        if self.gradient_average:
+            world = group_size(self.axis_index_groups, self.axis_name)
+            post = self.gradient_predivide_factor / world
+            flat = flat * post
+        elif self.gradient_predivide_factor != 1.0:
+            flat = flat * self.gradient_predivide_factor
+        return flat.astype(orig_dtype)
+
+    def allreduce_grads(self, grads):
+        """Synchronize a gradient pytree across the ``axis_name`` mesh axis.
+
+        Must be called inside ``shard_map``/``pmap`` where ``axis_name`` is
+        bound. Returns the synchronized (averaged by default) grads.
+        """
+        leaves, treedef = jax.tree.flatten(grads)
+        if not leaves:
+            return grads
+
+        if self.delay_allreduce:
+            # flat-buffer path: one allreduce over everything
+            flat, meta = ravel_list(leaves)
+            flat = self._reduce_flat(flat)
+            new_leaves = unravel_list(flat, meta)
+            return jax.tree.unflatten(treedef, new_leaves)
+
+        # bucketed path: reverse leaf order approximates the reference's
+        # reverse-ready-order bucket assembly
+        rev = list(reversed(leaves))
+        out = [None] * len(leaves)
+        for indices, flat, meta in flatten_buckets(rev, self.message_size):
+            flat = self._reduce_flat(flat)
+            pieces = unravel_list(flat, meta)
+            for piece, rev_idx in zip(pieces, indices):
+                out[len(leaves) - 1 - rev_idx] = piece
+        return jax.tree.unflatten(treedef, out)
+
+    def __call__(self, grads):
+        return self.allreduce_grads(grads)
+
+    def value_and_grad(self, loss_fn, **vg_kwargs):
+        """Convenience: ``jax.value_and_grad`` whose grads are synchronized
+        (the wrapped-model UX of the reference DDP)."""
+        vg = jax.value_and_grad(loss_fn, **vg_kwargs)
+
+        def wrapped(*args, **kwargs):
+            val, grads = vg(*args, **kwargs)
+            return val, self.allreduce_grads(grads)
+
+        return wrapped
+
+
+def flat_dist_call(tensors, axis_name: str = "data", op: str = "sum"):
+    """Parity helper for the reference's ``flat_dist_call``: flatten a list
+    of arrays, apply one collective, unflatten."""
+    flat, meta = ravel_list(list(tensors))
+    if op == "sum":
+        flat = jax.lax.psum(flat, axis_name)
+    elif op == "mean":
+        flat = jax.lax.pmean(flat, axis_name)
+    elif op == "max":
+        flat = jax.lax.pmax(flat, axis_name)
+    else:
+        raise ValueError(f"unsupported op {op!r}")
+    return unravel_list(flat, meta)

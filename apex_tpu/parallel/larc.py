@@ -1,0 +1,69 @@
+"""LARC — layer-wise adaptive rate clipping/scaling.
+
+Rebuild of ``apex/parallel/LARC.py`` (SURVEY.md §2.1): wraps an optimizer,
+computing a per-parameter adaptive learning rate
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay*||p|| + eps)
+
+and, like the reference, folding the wrapped optimizer's weight decay into
+the gradient before scaling (the inner optimizer then runs with wd=0).
+``clip=True`` caps the adaptive rate at the base lr (scale ≤ 1);
+``clip=False`` is pure LARS scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LARC:
+    optimizer: Any
+    trust_coefficient: float = 0.02
+    clip: bool = True
+    eps: float = 1e-8
+
+    @property
+    def lr(self):
+        return self.optimizer.lr
+
+    def with_master_weights(self, flag: bool = True):
+        return dataclasses.replace(
+            self, optimizer=self.optimizer.with_master_weights(flag)
+        )
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def _adjust(self, g, p, lr, weight_decay):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        adaptive_lr = (
+            self.trust_coefficient * p_norm
+            / (g_norm + p_norm * weight_decay + self.eps)
+        )
+        if self.clip:
+            scale = jnp.minimum(adaptive_lr / lr, 1.0)
+        else:
+            scale = adaptive_lr / lr
+        # Reference: the whole adjustment (wd fold-in AND scaling) happens
+        # only inside the `p_norm != 0 and g_norm != 0` branch; zero-norm
+        # params keep their raw gradient and get no decay at all.
+        adjusted = (g32 + weight_decay * p32) * scale
+        active = (p_norm > 0) & (g_norm > 0)
+        return jnp.where(active, adjusted, g32).astype(g.dtype)
+
+    def step(self, grads, state, params, skip_if=None, lr=None):
+        base_lr = self.optimizer.lr if lr is None else lr
+        wd = getattr(self.optimizer, "weight_decay", 0.0)
+        adjusted = jax.tree.map(
+            lambda g, p: self._adjust(g, p, base_lr, wd), grads, params
+        )
+        inner = self.optimizer.replace(weight_decay=0.0) if wd else self.optimizer
+        return inner.step(adjusted, state, params, skip_if=skip_if, lr=lr)

@@ -1,0 +1,23 @@
+"""Deprecated per-device process launcher (parity shim).
+
+The reference ``apex/parallel/multiproc.py`` spawns one process per GPU and
+was long deprecated in favor of ``torch.distributed.launch``. On TPU,
+process bootstrap belongs to ``jax.distributed.initialize`` (one process
+per host; devices discovered automatically), so this module only explains
+the migration.
+"""
+
+import sys
+
+
+def main():
+    sys.stderr.write(
+        "apex_tpu.parallel.multiproc is deprecated (as its reference was). "
+        "On TPU, launch one process per host and call "
+        "jax.distributed.initialize(); the mesh covers all chips.\n"
+    )
+    raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""SyncBatchNorm — cross-replica batch normalization over ICI.
+
+Rebuild of ``apex/parallel/optimized_sync_batchnorm*.py`` (SURVEY.md §3.5):
+the reference computes local Welford statistics with a CUDA kernel,
+all-gathers (count, mean, var) across the process group, combines them
+with ``welford_parallel``, then normalizes. The TPU-native version
+computes local (count, sum, sumsq) and combines across replicas with ONE
+``psum`` of the stacked triple — algebraically the parallel-Welford
+combination
+
+    M2_total = sum_i M2_i + sum_i n_i * (mean_i - mean_total)^2
+
+evaluated via sufficient statistics so a single fused collective suffices
+(fp32 accumulation keeps it stable at BN's scale).
+Knob parity: ``process_group`` → ``axis_index_groups`` subsets,
+``channel_last``, ``track_running_stats``, fp32 running stats under
+low-precision activations.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.collectives import axis_is_bound, psum_groups
+
+
+class SyncBatchNorm(nn.Module):
+    """flax module mirroring ``apex.parallel.SyncBatchNorm``.
+
+    Input layout: channel dim is axis 1 (torch NCHW convention) unless
+    ``channel_last`` (then the trailing axis). ``axis_name=None`` degrades
+    to plain (single-replica) BatchNorm, like the reference on world size 1.
+    ``num_features`` may be left at -1 to infer from the input (used by
+    :func:`convert_syncbn_model`, since flax BatchNorm infers too).
+    ``use_running_average`` selects eval behavior (flax convention; the
+    reference keys off ``module.training``); with
+    ``track_running_stats=False`` batch statistics are always used, per
+    torch semantics.
+    """
+
+    num_features: int = -1
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    use_scale: Optional[bool] = None  # finer-grained than affine, if set
+    use_bias: Optional[bool] = None
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "data"
+    process_group: Optional[Any] = None  # axis_index_groups
+    channel_last: bool = False
+    use_running_average: Optional[bool] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        # torch semantics: without tracked running stats, always normalize
+        # with batch statistics, training or not.
+        use_ra = use_ra and self.track_running_stats
+
+        ch_axis = (x.ndim - 1) if self.channel_last else min(1, x.ndim - 1)
+        nf = self.num_features if self.num_features > 0 else x.shape[ch_axis]
+        if x.shape[ch_axis] != nf:
+            raise ValueError(
+                f"expected {nf} channels on axis {ch_axis}, got shape {x.shape}"
+            )
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+        if self.track_running_stats:
+            ra_mean = self.variable(
+                "batch_stats", "mean", lambda: jnp.zeros((nf,), jnp.float32)
+            )
+            ra_var = self.variable(
+                "batch_stats", "var", lambda: jnp.ones((nf,), jnp.float32)
+            )
+        else:
+            ra_mean = ra_var = None
+
+        xf = x.astype(jnp.float32)
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            local_count = jnp.float32(x.size // nf)
+            local_sum = jnp.sum(xf, axis=reduce_axes)
+            local_sumsq = jnp.sum(xf * xf, axis=reduce_axes)
+            total_sum, total_sumsq, count = local_sum, local_sumsq, local_count
+            if self.axis_name is not None and axis_is_bound(self.axis_name) is not False:
+                stacked = jnp.concatenate(
+                    [local_sum, local_sumsq,
+                     jnp.full((1,), local_count, jnp.float32)]
+                )
+                try:
+                    stacked = psum_groups(stacked, self.axis_name, self.process_group)
+                except NameError:
+                    stacked = None  # axis unbound on a JAX without axis_env
+                if stacked is not None:
+                    total_sum = stacked[:nf]
+                    total_sumsq = stacked[nf: 2 * nf]
+                    count = stacked[-1]
+            elif self.axis_name is not None and not self.is_initializing():
+                warnings.warn(
+                    f"SyncBatchNorm: axis {self.axis_name!r} is not bound "
+                    "(not inside shard_map/pmap); falling back to LOCAL batch "
+                    "statistics. Pass axis_name=None to silence if single-"
+                    "replica use is intended.",
+                    stacklevel=2,
+                )
+            mean = total_sum / count
+            # biased variance for normalization (torch semantics)
+            var = total_sumsq / count - mean * mean
+
+            if self.track_running_stats and not self.is_initializing():
+                # running stats use the unbiased variance (torch semantics)
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+
+        shape = [1] * x.ndim
+        shape[ch_axis] = nf
+        y = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + self.eps)
+        use_scale = self.affine if self.use_scale is None else self.use_scale
+        use_bias = self.affine if self.use_bias is None else self.use_bias
+        if use_scale:
+            scale = self.param("scale", nn.initializers.ones, (nf,), self.param_dtype)
+            y = y * scale.reshape(shape).astype(jnp.float32)
+        if use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (nf,), self.param_dtype)
+            y = y + bias.reshape(shape).astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, axis_name: str = "data",
+                         process_group=None) -> nn.Module:
+    """Best-effort analog of ``apex.parallel.convert_syncbn_model``: return
+    a copy of a flax module with any direct ``nn.BatchNorm`` fields replaced
+    by :class:`SyncBatchNorm`.
+
+    flax modules are frozen dataclasses constructed per-call, so unlike the
+    torch version this cannot rewrite modules instantiated inside
+    ``__call__`` bodies — for those, parameterize the model on its norm
+    class and pass ``SyncBatchNorm``. Direct submodule fields (the
+    ``self.bn = nn.BatchNorm(...)`` setup-style pattern) are converted.
+    """
+    import dataclasses as dc
+
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            num_features=-1,  # inferred at call, like flax BatchNorm
+            eps=module.epsilon,
+            momentum=1.0 - module.momentum,  # flax stores the EMA keep-rate
+            use_scale=module.use_scale,
+            use_bias=module.use_bias,
+            use_running_average=module.use_running_average,
+            axis_name=axis_name,
+            process_group=process_group,
+            channel_last=True,  # flax BatchNorm is feature-last
+        )
+    if not dc.is_dataclass(module):
+        return module
+    changes = {}
+    for f in dc.fields(module):
+        try:
+            v = getattr(module, f.name)
+        except AttributeError:
+            continue
+        if isinstance(v, nn.BatchNorm):
+            changes[f.name] = convert_syncbn_model(v, axis_name, process_group)
+    return dc.replace(module, **changes) if changes else module

@@ -1,0 +1,63 @@
+"""Collective helpers shared across the parallel/transformer layers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def axis_is_bound(axis_name: str):
+    """Whether ``axis_name`` is currently a bound collective axis
+    (inside shard_map/pmap over it). Returns None if undeterminable on
+    this JAX version."""
+    try:
+        from jax._src import core as _core
+
+        return _core.get_axis_env().axis_exists(axis_name)
+    except Exception:
+        return None
+
+
+def psum_groups(x, axis_name: str, groups: Optional[Sequence[Sequence[int]]] = None):
+    """``lax.psum`` with subgroup support that works under ``shard_map``.
+
+    ``axis_index_groups`` is the reference ``process_group`` analog
+    (SyncBatchNorm subgroups, DDP partial worlds). This JAX version's
+    shard_map lowering raises NotImplementedError for grouped psum of
+    traced arrays, so when groups are given we fall back to an explicit
+    all_gather + static 0/1 group-mask contraction — semantically
+    identical, and XLA folds the mask multiply into the reduction.
+    """
+    if groups is None:
+        return jax.lax.psum(x, axis_name)
+    try:
+        return jax.lax.psum(x, axis_name, axis_index_groups=groups)
+    except NotImplementedError:
+        pass
+    world = jax.lax.psum(1, axis_name, axis_index_groups=None)
+    membership = np.zeros((world, world), np.float32)
+    for group in groups:
+        for i in group:
+            for j in group:
+                membership[i, j] = 1.0
+    gathered = jax.lax.all_gather(x, axis_name)  # (world, ...)
+    mask = jnp.asarray(membership)[jax.lax.axis_index(axis_name)]
+    return jnp.tensordot(mask, gathered.astype(jnp.float32), axes=1).astype(x.dtype)
+
+
+def group_size(groups: Optional[Sequence[Sequence[int]]], axis_name: str):
+    """Size of the caller's reduction group (static when groups are)."""
+    if groups is None:
+        return jax.lax.psum(1, axis_name)
+    sizes = {len(g) for g in groups}
+    if len(sizes) == 1:
+        return sizes.pop()
+    world = jax.lax.psum(1, axis_name)
+    per_dev = np.zeros((world,), np.float32)
+    for g in groups:
+        for i in g:
+            per_dev[i] = len(g)
+    return jnp.asarray(per_dev)[jax.lax.axis_index(axis_name)]

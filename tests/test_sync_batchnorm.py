@@ -1,0 +1,268 @@
+"""SyncBatchNorm tests on the 8-device CPU mesh (upstream analog:
+tests/distributed/synced_batchnorm/{single_gpu_unit_test,
+two_gpu_unit_test,test_groups}.py, SURVEY.md §4): synced stats must equal
+big-batch BatchNorm stats."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+
+def _mesh():
+    return jax.make_mesh((8,), ("data",))
+
+
+def _x(seed=0, shape=(8, 4, 3, 6, 5)):
+    # (devices, N, C, H, W) torch layout after sharding
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype("float32"))
+
+
+def _reference_bn(xb, eps=1e-5):
+    """Big-batch BN over (N, C, H, W) in numpy."""
+    mean = xb.mean(axis=(0, 2, 3))
+    var = xb.var(axis=(0, 2, 3))
+    return (xb - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + eps)
+
+
+def test_syncbn_matches_bigbatch_bn():
+    mesh = _mesh()
+    x = _x()
+    bn = SyncBatchNorm(num_features=3, axis_name="data")
+    variables = bn.init(jax.random.PRNGKey(0), jnp.zeros((4, 3, 6, 5)),
+                        use_running_average=False)
+
+    def f(v, x):
+        x = x.reshape(4, 3, 6, 5)  # local block
+        y, updates = bn.apply(v, x, use_running_average=False,
+                              mutable=["batch_stats"])
+        return y[None], updates["batch_stats"]
+
+    y, stats = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P(), P("data")),
+                      out_specs=(P("data"), P()))
+    )(variables, x)
+
+    xb = np.asarray(x).reshape(32, 3, 6, 5)
+    ref = _reference_bn(xb)
+    got = np.asarray(y).reshape(32, 3, 6, 5)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # running stats: momentum*batch (torch convention), unbiased var
+    n = 32 * 6 * 5
+    np.testing.assert_allclose(
+        np.asarray(stats["mean"]), 0.1 * xb.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats["var"]),
+        0.9 * 1.0 + 0.1 * xb.var(axis=(0, 2, 3)) * n / (n - 1),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_syncbn_channel_last():
+    mesh = _mesh()
+    x = _x(shape=(8, 4, 6, 5, 3))
+    bn = SyncBatchNorm(num_features=3, axis_name="data", channel_last=True)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.zeros((4, 6, 5, 3)),
+                        use_running_average=False)
+
+    def f(v, x):
+        x = x.reshape(4, 6, 5, 3)
+        y, _ = bn.apply(v, x, use_running_average=False, mutable=["batch_stats"])
+        return y[None]
+
+    y = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+    )(variables, x)
+    xb = np.asarray(x).reshape(32, 6, 5, 3).transpose(0, 3, 1, 2)
+    ref = _reference_bn(xb).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y).reshape(32, 6, 5, 3), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_syncbn_process_groups():
+    """test_groups analog: two groups of 4 normalize independently."""
+    mesh = _mesh()
+    x = _x()
+    groups = ((0, 1, 2, 3), (4, 5, 6, 7))
+    bn = SyncBatchNorm(num_features=3, axis_name="data", process_group=groups)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.zeros((4, 3, 6, 5)),
+                        use_running_average=False)
+
+    def f(v, x):
+        x = x.reshape(4, 3, 6, 5)
+        y, _ = bn.apply(v, x, use_running_average=False, mutable=["batch_stats"])
+        return y[None]
+
+    y = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+    )(variables, x)
+    got = np.asarray(y).reshape(8, 4, 3, 6, 5)
+    lo = _reference_bn(np.asarray(x)[:4].reshape(16, 3, 6, 5)).reshape(4, 4, 3, 6, 5)
+    hi = _reference_bn(np.asarray(x)[4:].reshape(16, 3, 6, 5)).reshape(4, 4, 3, 6, 5)
+    np.testing.assert_allclose(got[:4], lo, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got[4:], hi, rtol=1e-4, atol=1e-4)
+
+
+def test_syncbn_eval_uses_running_stats():
+    bn = SyncBatchNorm(num_features=3, axis_name=None)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.zeros((2, 3, 4, 4)),
+                        use_running_average=False)
+    variables = {
+        "params": variables["params"],
+        "batch_stats": {"mean": jnp.array([1.0, 2.0, 3.0]),
+                        "var": jnp.array([4.0, 4.0, 4.0])},
+    }
+    x = jnp.ones((2, 3, 4, 4))
+    y = bn.apply(variables, x, use_running_average=True)
+    exp = (1.0 - np.array([1, 2, 3])) / np.sqrt(4 + 1e-5)
+    np.testing.assert_allclose(np.asarray(y)[0, :, 0, 0], exp, rtol=1e-5)
+
+
+def test_syncbn_affine_and_dtype():
+    bn = SyncBatchNorm(num_features=4, axis_name=None)
+    v = bn.init(jax.random.PRNGKey(0), jnp.zeros((2, 4, 3, 3), jnp.bfloat16),
+                use_running_average=False)
+    assert v["params"]["scale"].dtype == jnp.float32
+    x = jnp.ones((2, 4, 3, 3), jnp.bfloat16)
+    y, _ = bn.apply(v, x, use_running_average=False, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16
+
+
+def test_syncbn_no_sync_matches_local_bn():
+    """axis_name=None degrades to plain BN."""
+    bn = SyncBatchNorm(num_features=3, axis_name=None)
+    x = _x(shape=(4, 3, 6, 5))
+    v = bn.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    y, _ = bn.apply(v, x, use_running_average=False, mutable=["batch_stats"])
+    ref = _reference_bn(np.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_syncbn_wrong_channels_raises():
+    bn = SyncBatchNorm(num_features=5, axis_name=None)
+    with pytest.raises(ValueError):
+        bn.init(jax.random.PRNGKey(0), jnp.zeros((2, 3, 4, 4)),
+                use_running_average=False)
+
+
+def test_convert_syncbn_model():
+    class Wrapper(nn.Module):
+        bn: nn.Module
+
+        def __call__(self, x):
+            return self.bn(x)
+
+    m = Wrapper(bn=nn.BatchNorm(use_running_average=False))
+    converted = convert_syncbn_model(m, axis_name="data")
+    assert isinstance(converted.bn, SyncBatchNorm)
+    assert converted.bn.axis_name == "data"
+    assert converted.bn.channel_last  # flax BN is feature-last
+
+
+def test_larc_scales_updates():
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import LARC
+
+    params = {"big": jnp.full((16,), 100.0), "small": jnp.full((16,), 0.01)}
+    grads = {"big": jnp.full((16,), 1.0), "small": jnp.full((16,), 1.0)}
+    base = FusedSGD(lr=1.0, momentum=0.0, weight_decay=0.0)
+    larc = LARC(base, trust_coefficient=0.001, clip=True)
+    st = larc.init(params)
+    p, _ = larc.step(grads, st, params)
+
+    # big: adaptive_lr = 0.001*400/(4) = 0.1 -> scale 0.1 (clip at 1)
+    big_norm = np.sqrt(16 * 100.0 ** 2)
+    g_norm = 4.0
+    scale_big = min(0.001 * big_norm / g_norm / 1.0, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(p["big"]), 100.0 - scale_big * 1.0, rtol=1e-5
+    )
+    # small params get tiny adaptive lr -> nearly frozen
+    assert abs(float(p["small"][0]) - 0.01) < 1e-4
+
+
+def test_larc_folds_weight_decay_into_grad():
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import LARC
+
+    params = {"w": jnp.full((4,), 2.0)}
+    grads = {"w": jnp.full((4,), 0.5)}
+    base = FusedSGD(lr=0.1, momentum=0.0, weight_decay=0.5)
+    larc = LARC(base, trust_coefficient=0.02, clip=False)
+    p, _ = larc.step(grads, larc.init(params), params)
+    pn = np.sqrt(4 * 4.0)  # ||p|| = 4
+    gn = np.sqrt(4 * 0.25)  # ||g|| = 1
+    adaptive = 0.02 * pn / (gn + 0.5 * pn + 1e-8)
+    # g' = (g + wd*p) * adaptive/lr; inner optimizer runs with wd = 0
+    gprime = (0.5 + 0.5 * 2.0) * (adaptive / 0.1)
+    exp = 2.0 - 0.1 * gprime
+    np.testing.assert_allclose(np.asarray(p["w"]), exp, rtol=1e-5)
+
+
+def test_larc_zero_grad_param_is_untouched():
+    """Reference: the wd fold-in and scaling happen only for params with
+    nonzero p/g norms; a frozen (zero-grad) param receives NO decay."""
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import LARC
+
+    params = {"w": jnp.full((4,), 2.0)}
+    grads = {"w": jnp.zeros((4,))}
+    base = FusedSGD(lr=0.1, momentum=0.0, weight_decay=0.5)
+    larc = LARC(base, trust_coefficient=0.02, clip=False)
+    p, _ = larc.step(grads, larc.init(params), params)
+    np.testing.assert_allclose(np.asarray(p["w"]), 2.0, rtol=1e-6)
+
+
+def test_converted_module_is_usable():
+    """Review regression: the converter's output must actually apply."""
+    m = convert_syncbn_model(nn.BatchNorm(use_running_average=False))
+    x = jnp.asarray(np.random.RandomState(0).randn(6, 4, 3).astype("float32"))
+    v = m.init(jax.random.PRNGKey(0), x)
+    y, _ = m.apply(v, x, mutable=["batch_stats"])
+    # feature-last normalization over (6,4) per channel
+    ref = (np.asarray(x) - np.asarray(x).mean((0, 1))) / np.sqrt(
+        np.asarray(x).var((0, 1)) + 1e-5
+    )
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convert_preserves_scale_bias_split():
+    m = convert_syncbn_model(nn.BatchNorm(use_running_average=False,
+                                          use_scale=False, use_bias=True))
+    x = jnp.ones((4, 3))
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert "scale" not in v["params"]
+    assert "bias" in v["params"]
+
+
+def test_no_track_running_stats_uses_batch_stats_at_eval():
+    """torch semantics: track_running_stats=False always normalizes with
+    batch statistics (review regression)."""
+    bn = SyncBatchNorm(num_features=3, axis_name=None, track_running_stats=False)
+    x = 5.0 * jnp.ones((2, 3, 4, 4)) + jnp.asarray(
+        np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32"))
+    v = bn.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    assert "batch_stats" not in v  # no dead collection
+    y = bn.apply(v, x, use_running_average=True)
+    assert abs(float(jnp.mean(y))) < 1e-5  # normalized, not identity
+
+
+def test_unbound_axis_warns_and_falls_back_local():
+    bn = SyncBatchNorm(num_features=3, axis_name="data")
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3, 5, 5).astype("float32"))
+    v = bn.init(jax.random.PRNGKey(0), x, use_running_average=False)  # no warn at init
+    import warnings as w
+
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        y, _ = bn.apply(v, x, use_running_average=False, mutable=["batch_stats"])
+        assert any("not bound" in str(c.message) for c in caught)
+    ref = _reference_bn(np.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
