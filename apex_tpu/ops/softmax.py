@@ -1,0 +1,185 @@
+"""Pallas TPU kernels: fused scale + mask + softmax (fwd + bwd).
+
+Rebuild of the reference's ``csrc/megatron/scaled_masked_softmax*.cu`` and
+``scaled_upper_triang_masked_softmax*.cu`` (SURVEY.md §2.2): attention-
+score softmax with the scale multiply and (padding or causal) mask folded
+into one pass — the op behind ``FusedScaleMaskSoftmax``
+(``apex/transformer/functional``).
+
+TPU design: rows are flattened to (N, Sk) and tiled into VMEM row blocks;
+max/sum are VPU lane reductions; the causal mask is generated in-kernel
+from ``broadcasted_iota`` (no mask tensor traffic, like the reference's
+upper-triang variant); the key dim is padded to the 128-lane width with
+``-inf``-equivalent so padded lanes contribute zero probability. Backward
+uses the saved softmax output: dx = scale * y * (g - sum(g*y)).
+
+Unlike the CUDA kernels (hard seq-len limits 16..16384, pow-2 shapes —
+their ``is_kernel_available`` gate), any shape works here; the module
+keeps the gate trivially true.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+_NEG = -30000.0  # large-negative fill, safe in bf16/fp32 (reference: -10000)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def _block_rows(n):
+    if n >= 256:
+        return 256
+    return _round_up(max(n, 1), 8)
+
+
+def _fwd_kernel(x_ref, y_ref, *, scale, causal, sq, true_k, padded):
+    x = x_ref[:].astype(jnp.float32) * scale
+    rows = x.shape[0]
+    if padded:
+        col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(col < true_k, x, _NEG)
+    if causal:
+        # global row index = block_start + local row; key col must be <= the
+        # query position (row % sq when rows are (b*h*sq))
+        row0 = pl.program_id(0) * rows
+        local = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        q_pos = (row0 + local) % sq
+        col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(col <= q_pos, x, _NEG)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    y_ref[:] = (e / s).astype(y_ref.dtype)
+
+
+def _bwd_kernel(g_ref, y_ref, dx_ref, *, scale):
+    g = g_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    dot = jnp.sum(g * y, axis=1, keepdims=True)
+    dx_ref[:] = (scale * y * (g - dot)).astype(dx_ref.dtype)
+
+
+def _pallas_softmax_fwd(x2, *, scale, causal, sq, true_k):
+    n, kpad = x2.shape
+    br = _block_rows(n)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, sq=sq,
+                          true_k=true_k, padded=(true_k != kpad)),
+        grid=(n // br,),
+        in_specs=[pl.BlockSpec((br, kpad), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, kpad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, kpad), x2.dtype),
+        interpret=_interpret(),
+    )(x2)
+
+
+def _pallas_softmax_bwd(g2, y2, *, scale):
+    n, kpad = g2.shape
+    br = _block_rows(n)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(n // br,),
+        in_specs=[
+            pl.BlockSpec((br, kpad), lambda i: (i, 0)),
+            pl.BlockSpec((br, kpad), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, kpad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, kpad), g2.dtype),
+        interpret=_interpret(),
+    )(g2, y2)
+
+
+def _prep(x):
+    k = x.shape[-1]
+    lead = x.shape[:-1]
+    n = 1
+    for d in lead:
+        n *= d
+    x2 = x.reshape(n, k)
+    kpad = _round_up(k, LANE)
+    npad = _round_up(n, _block_rows(n))
+    if kpad != k or npad != n:
+        x2 = jnp.pad(x2, ((0, npad - n), (0, kpad - k)))
+    return x2, lead, n, k
+
+
+def _softmax_impl(x, scale, causal, sq):
+    x2, lead, n, k = _prep(x)
+    y2 = _pallas_softmax_fwd(x2, scale=scale, causal=causal, sq=sq, true_k=k)
+    return y2[:n, :k].reshape(*lead, k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _fused_softmax(x, scale, causal):
+    sq = x.shape[-2] if causal else 0
+    return _softmax_impl(x, scale, causal, sq)
+
+
+def _fs_fwd(x, scale, causal):
+    sq = x.shape[-2] if causal else 0
+    y = _softmax_impl(x, scale, causal, sq)
+    return y, y
+
+
+def _fs_bwd(scale, causal, y, g):
+    y2, lead, n, k = _prep(y)
+    g2, _, _, _ = _prep(g)
+    dx2 = _pallas_softmax_bwd(g2, y2, scale=scale)
+    return (dx2[:n, :k].reshape(*lead, k),)
+
+
+_fused_softmax.defvjp(_fs_fwd, _fs_bwd)
+
+
+def scaled_softmax(x, scale: float = 1.0):
+    """softmax(scale * x) (reference: ``scaled_softmax_cuda``)."""
+    return _fused_softmax(x, float(scale), False)
+
+
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """softmax(scale * x + mask) for a padding mask (reference:
+    ``scaled_masked_softmax_cuda``). ``mask`` is boolean (True = masked,
+    the reference convention) or additive float; broadcastable to x."""
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            x = jnp.where(mask, jnp.asarray(_NEG / max(scale, 1e-6), x.dtype), x)
+        else:
+            x = x + (mask / max(scale, 1e-6)).astype(x.dtype)
+    return _fused_softmax(x, float(scale), False)
+
+
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """Causal softmax(scale * x) over (..., sq, sk) with sq == sk
+    (reference: ``scaled_upper_triang_masked_softmax_cuda``); the causal
+    mask is generated in-kernel."""
+    if x.shape[-1] != x.shape[-2]:
+        raise ValueError("causal softmax requires square (sq, sk) trailing dims")
+    return _fused_softmax(x, float(scale), True)
+
+
+def softmax_reference(x, mask=None, scale=1.0, causal=False):
+    """Pure-jnp reference for tests."""
+    xf = x.astype(jnp.float32) * scale
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            xf = jnp.where(mask, _NEG, xf)
+        else:
+            xf = xf + mask
+    if causal:
+        q = xf.shape[-2]
+        kk = xf.shape[-1]
+        tri = jnp.tril(jnp.ones((q, kk), bool))
+        xf = jnp.where(tri, xf, _NEG)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)

@@ -73,9 +73,8 @@ class DistributedDataParallel:
             return True  # pmap / older tracer: assume varying
         return self.axis_name in vma
 
-    def _reduce_flat(self, flat):
+    def _reduce_flat(self, flat, needs_psum: bool):
         orig_dtype = flat.dtype
-        needs_psum = self._is_varying(flat)
         if self.allreduce_always_fp32:
             flat = flat.astype(jnp.float32)
         if self.gradient_predivide_factor != 1.0:
@@ -95,27 +94,36 @@ class DistributedDataParallel:
 
         Must be called inside ``shard_map``/``pmap`` where ``axis_name`` is
         bound. Returns the synchronized (averaged by default) grads.
+
+        Leaves are segregated by varying-ness BEFORE any concatenation:
+        mixing an already-summed (unvarying) leaf into a buffer with a
+        varying one would promote it and psum it a second time.
         """
         leaves, treedef = jax.tree.flatten(grads)
         if not leaves:
             return grads
 
-        if self.delay_allreduce:
-            # flat-buffer path: one allreduce over everything
-            flat, meta = ravel_list(leaves)
-            flat = self._reduce_flat(flat)
-            new_leaves = unravel_list(flat, meta)
-            return jax.tree.unflatten(treedef, new_leaves)
-
-        # bucketed path: reverse leaf order approximates the reference's
-        # reverse-ready-order bucket assembly
-        rev = list(reversed(leaves))
         out = [None] * len(leaves)
-        for indices, flat, meta in flatten_buckets(rev, self.message_size):
-            flat = self._reduce_flat(flat)
-            pieces = unravel_list(flat, meta)
-            for piece, rev_idx in zip(pieces, indices):
-                out[len(leaves) - 1 - rev_idx] = piece
+        # reverse leaf order approximates the reference's reverse-ready-
+        # order bucket assembly
+        rev_ids = list(range(len(leaves)))[::-1]
+        for needs_psum in (True, False):
+            group_ids = [i for i in rev_ids if self._is_varying(leaves[i]) == needs_psum]
+            if not group_ids:
+                continue
+            group = [leaves[i] for i in group_ids]
+            if self.delay_allreduce:
+                # flat-buffer path: one allreduce over the whole group
+                flat, meta = ravel_list(group)
+                pieces = unravel_list(self._reduce_flat(flat, needs_psum), meta)
+                for piece, i in zip(pieces, group_ids):
+                    out[i] = piece
+            else:
+                for indices, flat, meta in flatten_buckets(group, self.message_size):
+                    flat = self._reduce_flat(flat, needs_psum)
+                    pieces = unravel_list(flat, meta)
+                    for piece, pos in zip(pieces, indices):
+                        out[group_ids[pos]] = piece
         return jax.tree.unflatten(treedef, out)
 
     def __call__(self, grads):

@@ -49,9 +49,12 @@ class LARC:
             / (g_norm + p_norm * weight_decay + self.eps)
         )
         if self.clip:
+            # reference: grad *= min(adaptive_lr/lr, 1) -> step capped at lr
             scale = jnp.minimum(adaptive_lr / lr, 1.0)
         else:
-            scale = adaptive_lr / lr
+            # reference: grad *= adaptive_lr (inner optimizer applies lr on
+            # top) -> step = lr * adaptive_lr * g
+            scale = adaptive_lr
         # Reference: the whole adjustment (wd fold-in AND scaling) happens
         # only inside the `p_norm != 0 and g_norm != 0` branch; zero-norm
         # params keep their raw gradient and get no decay at all.

@@ -91,27 +91,30 @@ class SyncBatchNorm(nn.Module):
             local_sum = jnp.sum(xf, axis=reduce_axes)
             local_sumsq = jnp.sum(xf * xf, axis=reduce_axes)
             total_sum, total_sumsq, count = local_sum, local_sumsq, local_count
-            if self.axis_name is not None and axis_is_bound(self.axis_name) is not False:
-                stacked = jnp.concatenate(
-                    [local_sum, local_sumsq,
-                     jnp.full((1,), local_count, jnp.float32)]
-                )
-                try:
-                    stacked = psum_groups(stacked, self.axis_name, self.process_group)
-                except NameError:
-                    stacked = None  # axis unbound on a JAX without axis_env
+            if self.axis_name is not None:
+                stacked = None
+                if axis_is_bound(self.axis_name) is not False:
+                    packed = jnp.concatenate(
+                        [local_sum, local_sumsq,
+                         jnp.full((1,), local_count, jnp.float32)]
+                    )
+                    try:
+                        stacked = psum_groups(packed, self.axis_name,
+                                              self.process_group)
+                    except NameError:
+                        stacked = None  # axis unbound (no axis_env probe)
                 if stacked is not None:
                     total_sum = stacked[:nf]
                     total_sumsq = stacked[nf: 2 * nf]
                     count = stacked[-1]
-            elif self.axis_name is not None and not self.is_initializing():
-                warnings.warn(
-                    f"SyncBatchNorm: axis {self.axis_name!r} is not bound "
-                    "(not inside shard_map/pmap); falling back to LOCAL batch "
-                    "statistics. Pass axis_name=None to silence if single-"
-                    "replica use is intended.",
-                    stacklevel=2,
-                )
+                elif not self.is_initializing():
+                    warnings.warn(
+                        f"SyncBatchNorm: axis {self.axis_name!r} is not bound "
+                        "(not inside shard_map/pmap); falling back to LOCAL "
+                        "batch statistics. Pass axis_name=None to silence if "
+                        "single-replica use is intended.",
+                        stacklevel=2,
+                    )
             mean = total_sum / count
             # biased variance for normalization (torch semantics)
             var = total_sumsq / count - mean * mean

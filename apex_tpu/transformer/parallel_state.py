@@ -1,0 +1,160 @@
+"""Model-parallel process-group bookkeeping on a named device mesh.
+
+Rebuild of ``apex/transformer/parallel_state.py`` (SURVEY.md §2.4): the
+reference builds NCCL groups (`_TENSOR_MODEL_PARALLEL_GROUP`,
+`_PIPELINE_MODEL_PARALLEL_GROUP`, `_DATA_PARALLEL_GROUP`, embedding
+groups) from a flat world. On TPU the same bookkeeping is a
+``jax.sharding.Mesh`` with named axes:
+
+    mesh axes (outer→inner): ("pipeline", "data", "tensor")
+
+Tensor-parallel is innermost so TP collectives ride nearest-neighbor ICI
+links; pipeline is outermost so PP hops can cross DCN on multi-slice
+topologies (the reference has no topology awareness at all — SURVEY.md
+§2.4 — so this is a strict upgrade).
+
+Rank getters come in two flavors: static sizes (usable anywhere) and
+in-context ranks (``*_rank()``), which require a bound axis (inside
+``shard_map`` over the mesh) and return traced values, mirroring how the
+reference's rank queries require an initialized process group.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_MESH: Optional[Mesh] = None
+_TP_SIZE = 1
+_PP_SIZE = 1
+_DP_SIZE = 1
+_VIRTUAL_PP_SIZE: Optional[int] = None
+
+TENSOR_AXIS = "tensor"
+PIPELINE_AXIS = "pipeline"
+DATA_AXIS = "data"
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global mesh (reference:
+    ``initialize_model_parallel``). Data-parallel size is inferred as
+    ``world // (tp * pp)``, exactly like the reference."""
+    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _VIRTUAL_PP_SIZE
+
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    tp = int(tensor_model_parallel_size_)
+    pp = int(pipeline_model_parallel_size_)
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor parallel size "
+            f"({tp}) times pipeline parallel size ({pp})"
+        )
+    dp = world // (tp * pp)
+    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    _MESH = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    _TP_SIZE, _PP_SIZE, _DP_SIZE = tp, pp, dp
+    _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def destroy_model_parallel():
+    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _VIRTUAL_PP_SIZE
+    _MESH = None
+    _TP_SIZE = _PP_SIZE = _DP_SIZE = 1
+    _VIRTUAL_PP_SIZE = None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _MESH
+
+
+# -- group handles (axis names stand in for process groups) ----------------
+
+def get_tensor_model_parallel_group() -> str:
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return PIPELINE_AXIS
+
+
+def get_data_parallel_group() -> str:
+    return DATA_AXIS
+
+
+# -- static sizes ----------------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _TP_SIZE
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _PP_SIZE
+
+
+def get_data_parallel_world_size() -> int:
+    return _DP_SIZE
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_SIZE
+
+
+# -- in-context (traced) ranks --------------------------------------------
+
+def get_tensor_model_parallel_rank():
+    """Traced TP rank; requires a bound ``tensor`` axis (inside shard_map)."""
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = True):
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = True):
+    return get_pipeline_model_parallel_rank() == _PP_SIZE - 1
+
+
+# vocab range helper used by VocabParallelEmbedding / parallel CE
+class VocabUtility:
+    """Reference: ``tensor_parallel/utils.py:VocabUtility``."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size, rank):
+        start = rank * per_partition_vocab_size
+        return start, start + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank, world_size):
+        if global_vocab_size % world_size != 0:
+            raise ValueError(
+                f"vocab size ({global_vocab_size}) must be divisible by "
+                f"tensor parallel size ({world_size})"
+            )
+        per = global_vocab_size // world_size
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(per, rank)

@@ -1,0 +1,63 @@
+"""Stage-to-stage communication primitives.
+
+Rebuild of ``apex/transformer/pipeline_parallel/p2p_communication.py``
+(SURVEY.md §3.5): the reference wraps batched NCCL isend/irecv with shape
+negotiation (``_communicate``). On TPU, point-to-point transfer between
+pipeline stages is ``lax.ppermute`` over the ``pipeline`` axis — shapes
+are static under jit, so the negotiation machinery disappears; each helper
+keeps its reference name/direction. All helpers require the pipeline axis
+bound (inside shard_map).
+
+These are the building blocks :mod:`schedules` uses; exposed for users
+porting custom schedules.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.transformer import parallel_state
+
+
+def _perm_forward():
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+    return [(i, (i + 1) % pp) for i in range(pp)]
+
+
+def _perm_backward():
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+    return [(i, (i - 1) % pp) for i in range(pp)]
+
+
+def send_forward(x, axis_name=None):
+    """Ship activations to the next stage (reference: ``send_forward``).
+    Returns what this stage receives from its predecessor."""
+    axis = axis_name or parallel_state.PIPELINE_AXIS
+    return jax.lax.ppermute(x, axis, _perm_forward())
+
+
+def send_backward(x, axis_name=None):
+    """Ship gradients to the previous stage (reference: ``send_backward``)."""
+    axis = axis_name or parallel_state.PIPELINE_AXIS
+    return jax.lax.ppermute(x, axis, _perm_backward())
+
+
+def recv_forward(x, axis_name=None):
+    """Alias of :func:`send_forward` viewed from the receiver (the
+    reference's paired recv; ppermute is symmetric)."""
+    return send_forward(x, axis_name)
+
+
+def recv_backward(x, axis_name=None):
+    return send_backward(x, axis_name)
+
+
+def send_forward_recv_backward(fwd, bwd, axis_name=None):
+    """Bidirectional exchange (reference name preserved): one hop forward
+    for activations and one hop backward for gradients, issued together so
+    XLA can overlap them on opposite ICI directions."""
+    return send_forward(fwd, axis_name), send_backward(bwd, axis_name)
+
+
+def send_backward_recv_forward(bwd, fwd, axis_name=None):
+    return send_backward(bwd, axis_name), send_forward(fwd, axis_name)

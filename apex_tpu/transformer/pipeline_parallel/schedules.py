@@ -1,0 +1,195 @@
+"""Pipeline-parallel schedules as SPMD collective-permute pipelines.
+
+Rebuild of ``apex/transformer/pipeline_parallel/schedules.py`` (SURVEY.md
+§3.5): the reference drives 1F1B with explicit NCCL send/recv per
+microbatch hop (warmup = ``pp_size - rank - 1`` forwards, steady-state
+alternation, cooldown drain), because torch must schedule imperatively.
+
+TPU design (SURVEY.md §7 hard part 4): the schedule is DATA FLOW, not
+control flow. Every stage runs the same program: a ``lax.scan`` over
+``num_microbatches + pp - 1`` ticks in which each device
+
+  1. selects its current input (stage 0: the next microbatch; others: the
+     activation received from the left neighbor),
+  2. applies its stage's layer stack,
+  3. ``ppermute``\\ s the activation to the right neighbor.
+
+The last stage accumulates per-microbatch outputs/losses. Differentiating
+through the scan gives the reverse pipeline (cooldown) automatically, with
+activation rematerialization via ``jax.checkpoint`` on the stage fn; XLA's
+latency-hiding scheduler overlaps the ppermute with compute — which is
+exactly the role of the reference's explicit 1F1B interleaving. Microbatch
+bookkeeping (SURVEY.md: ``apex/transformer/microbatches.py``) reduces to
+the ``num_microbatches`` argument.
+
+Used inside ``shard_map`` over the ``pipeline`` mesh axis, with each
+device holding its stage's parameter shard (stack parameters along a
+leading ``pp`` axis and shard it over ``pipeline``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+
+def _axis():
+    return parallel_state.PIPELINE_AXIS
+
+
+def _shift_right(x, axis_name, pp):
+    """Send to stage s+1; stage 0 receives stage pp-1's value (ignored)."""
+    from apex_tpu.transformer.pipeline_parallel import p2p_communication
+
+    return p2p_communication.send_forward(x, axis_name)
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    num_microbatches: int,
+    remat: bool = True,
+    axis_name: Optional[str] = None,
+):
+    """Run a pipelined forward pass.
+
+    Args:
+      stage_fn: ``(params, x, microbatch_index) -> x`` — one stage's
+        compute, applied by every device to its local params.
+      stage_params: this device's stage parameters (inside shard_map these
+        are the local shard of a pp-stacked pytree).
+      microbatches: (num_microbatches, mb, ...) inputs, replicated across
+        the pipeline axis (stage 0 reads them; other stages ignore).
+      num_microbatches: M. Total ticks = M + pp - 1.
+      remat: rematerialize stage activations in backward
+        (``jax.checkpoint``), the reference's activation-recompute default
+        for pipeline training.
+
+    Returns:
+      (num_microbatches, mb, ...) outputs as produced by the LAST stage
+      (valid there; other stages hold garbage — reduce over the axis or
+      read stage pp-1's shard).
+    """
+    axis = axis_name or _axis()
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+    stage = jax.lax.axis_index(axis)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    mb_shape = microbatches.shape[1:]
+    total_ticks = num_microbatches + pp - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb_idx = t - stage  # microbatch this stage works on at tick t
+        active = (mb_idx >= 0) & (mb_idx < num_microbatches)
+
+        # stage 0 injects a fresh microbatch; others use the received state
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, num_microbatches - 1), keepdims=False
+        )
+        x_in = jnp.where(stage == 0, inject, state)
+
+        y = fn(stage_params, x_in, mb_idx)
+        # inactive ticks pass state through unchanged (keeps shapes static)
+        y = jnp.where(active, y, state)
+
+        # last stage records its finished microbatch
+        out_idx = jnp.clip(t - (pp - 1), 0, num_microbatches - 1)
+        record = (stage == pp - 1) & (t >= pp - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(record, y, jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)),
+            out_idx,
+            axis=0,
+        )
+
+        # ship activations rightward for the next tick
+        state = _shift_right(y, axis, pp) if pp > 1 else y
+        return (state, outputs), None
+
+    # The carry is device-varying from tick 1 on (ppermute), and the stage
+    # fn may introduce MORE varying axes (e.g. TP collectives inside the
+    # stage make activations tensor-varying). The scan needs a stable carry
+    # type, so infer the fixed point of the stage fn's output varying-set
+    # via eval_shape (abstract — no compute is added).
+    from apex_tpu.utils.collectives import mark_varying
+
+    try:
+        mb_vma = frozenset(jax.typeof(microbatches).vma)
+    except (AttributeError, TypeError):
+        mb_vma = frozenset()
+    vma = frozenset({axis}) | mb_vma  # injected microbatches carry their own
+    for _ in range(3):
+        def _probe(vma=vma):
+            x = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), tuple(vma))
+            return fn(stage_params, x, jnp.int32(0))
+
+        out_vma = frozenset(getattr(jax.eval_shape(_probe), "vma", ())) | vma
+        if out_vma == vma:
+            break
+        vma = out_vma
+    mark = tuple(vma)
+
+    init_state = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), mark)
+    init_out = mark_varying(
+        jnp.zeros((num_microbatches,) + mb_shape, microbatches.dtype), mark)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (init_state, init_out), jnp.arange(total_ticks)
+    )
+    return outputs
+
+
+def forward_backward_pipelining_without_interleaving(
+    forward_step_fn: Callable,
+    batch,
+    stage_params,
+    *,
+    num_microbatches: int,
+    loss_fn: Callable,
+    remat: bool = True,
+    axis_name: Optional[str] = None,
+):
+    """1F1B-equivalent pipelined loss + gradients (reference:
+    ``forward_backward_pipelining_without_interleaving``).
+
+    Args:
+      forward_step_fn: ``(params, x, mb_idx) -> activation`` per stage.
+      batch: (num_microbatches, mb, ...) microbatched inputs.
+      stage_params: per-stage local params (pp-stacked, sharded).
+      loss_fn: ``(last_stage_output, mb_idx) -> scalar`` per microbatch;
+        evaluated on the last stage, mean-reduced over microbatches.
+
+    Returns:
+      (loss, grads) with loss replicated across stages and grads local to
+      each stage's params — the reference returns per-stage losses and
+      leaves grads in ``param.grad`` similarly.
+    """
+    axis = axis_name or _axis()
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+
+    def pipeline_loss(params):
+        outs = spmd_pipeline(
+            forward_step_fn, params, batch,
+            num_microbatches=num_microbatches, remat=remat, axis_name=axis,
+        )
+        per_mb = jax.vmap(loss_fn)(outs, jnp.arange(num_microbatches))
+        local = jnp.mean(per_mb)
+        stage = jax.lax.axis_index(axis)
+        # only the last stage's loss is real; zero others then sum
+        return jax.lax.psum(jnp.where(stage == pp - 1, local, 0.0), axis)
+
+    loss, grads = jax.value_and_grad(pipeline_loss)(stage_params)
+    return loss, grads
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
+                              pipeline_model_parallel_size=None):
+    """Reference dispatcher: interleaved scheduling is delegated to XLA's
+    scheduler here, so both cases map to the same SPMD pipeline."""
+    return forward_backward_pipelining_without_interleaving

@@ -1,0 +1,95 @@
+"""Vocab-parallel cross entropy.
+
+Rebuild of ``apex/transformer/tensor_parallel/cross_entropy.py``
+(SURVEY.md §2.3): softmax cross entropy over vocab-sharded logits without
+ever materializing the full-vocab row. The reference's recipe is kept
+exactly — local max → all-reduce(max), subtract, local sum-exp →
+all-reduce(sum), local target-logit gather with out-of-range masking →
+all-reduce(sum) — with the collectives as ``pmax``/``psum`` over the
+``tensor`` axis, and a custom_vjp backward reproducing
+(softmax - one_hot) on the local shard only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+
+def _axis():
+    return parallel_state.TENSOR_AXIS
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def vocab_parallel_cross_entropy(vocab_parallel_logits, target, label_smoothing=0.0):
+    """Per-token loss for vocab-sharded logits.
+
+    Args:
+      vocab_parallel_logits: (..., vocab/tp) local logits shard.
+      target: (...) integer ids in [0, vocab).
+    Returns:
+      (...) per-token losses (replicated across the TP axis).
+    """
+    loss, _ = _ce_fwd_impl(vocab_parallel_logits, target, label_smoothing)
+    return loss
+
+
+def _ce_fwd_impl(logits, target, label_smoothing):
+    tp = parallel_state.get_tensor_model_parallel_world_size()
+    rank = jax.lax.axis_index(_axis())
+    per = logits.shape[-1]
+    vocab = per * tp
+
+    lf = logits.astype(jnp.float32)
+    local_max = jnp.max(lf, axis=-1)
+    global_max = jax.lax.pmax(local_max, _axis())
+    shifted = lf - global_max[..., None]
+    exp = jnp.exp(shifted)
+    local_sumexp = jnp.sum(exp, axis=-1)
+    global_sumexp = jax.lax.psum(local_sumexp, _axis())
+
+    start = rank * per
+    local_t = target - start
+    in_range = (local_t >= 0) & (local_t < per)
+    safe_t = jnp.where(in_range, local_t, 0)
+    target_shifted = jnp.take_along_axis(shifted, safe_t[..., None], axis=-1)[..., 0]
+    target_shifted = jnp.where(in_range, target_shifted, 0.0)
+    target_shifted = jax.lax.psum(target_shifted, _axis())
+
+    loss = jnp.log(global_sumexp) - target_shifted
+    if label_smoothing > 0.0:
+        # reference smoothing: mix in the mean of all log-probs
+        # loss = (1-eps)*nll + eps * mean_i(-log p_i)
+        log_probs = shifted - jnp.log(global_sumexp)[..., None]
+        local_mean_term = jnp.sum(log_probs, axis=-1)
+        global_mean = jax.lax.psum(local_mean_term, _axis()) / vocab
+        loss = (1.0 - label_smoothing) * loss - label_smoothing * global_mean
+
+    residuals = (exp, global_sumexp, in_range, safe_t, vocab)
+    return loss, residuals
+
+
+def _ce_fwd(logits, target, label_smoothing):
+    loss, res = _ce_fwd_impl(logits, target, label_smoothing)
+    # zero-size sentinel carries the primal dtype (residuals must be arrays)
+    return loss, (res, jnp.zeros((0,), logits.dtype))
+
+
+def _ce_bwd(label_smoothing, fwd_res, g):
+    (exp, global_sumexp, in_range, safe_t, vocab), dtype_sentinel = fwd_res
+    dtype = dtype_sentinel.dtype
+    softmax = exp / global_sumexp[..., None]
+    one_hot = jax.nn.one_hot(safe_t, exp.shape[-1], dtype=jnp.float32)
+    one_hot = one_hot * in_range[..., None]
+    if label_smoothing > 0.0:
+        grad = softmax - (1.0 - label_smoothing) * one_hot - label_smoothing / vocab
+    else:
+        grad = softmax - one_hot
+    return (grad * g[..., None]).astype(dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

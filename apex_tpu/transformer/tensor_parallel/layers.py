@@ -1,0 +1,199 @@
+"""Tensor-parallel layers: Column/Row-parallel linear, vocab-parallel
+embedding.
+
+Rebuild of ``apex/transformer/tensor_parallel/layers.py`` (SURVEY.md §2.3)
+as flax modules holding LOCAL weight shards, for use inside ``shard_map``
+over the ``tensor`` mesh axis. Knob parity: ``gather_output``,
+``input_is_parallel``, ``skip_bias_add``, ``bias``,
+``sequence_parallel_enabled``; ``gradient_accumulation_fusion`` is
+accepted and ignored (XLA fuses the wgrad accumulation into the backward
+dot — the very thing ``fused_weight_gradient_mlp_cuda`` exists for,
+SURVEY.md §2.2).
+
+Weight partitioning matches the reference: ColumnParallelLinear splits the
+output dim, RowParallelLinear the input dim, VocabParallelEmbedding the
+vocab rows. Per-rank initialization derives from a shared key +
+``fold_in(tp_rank)`` so the full weight matrix is reproducible (the
+reference's ``_initialize_affine_weight`` master-weight scheme).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_along_first_dim,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_along_first_dim,
+    scatter_to_tensor_model_parallel_region,
+)
+
+from apex_tpu.transformer.tensor_parallel.random import model_parallel_key
+
+default_init = nn.initializers.lecun_normal()
+
+# per-TP-rank init key (reference: per-rank RNG tracker seeds)
+_rank_key = model_parallel_key
+
+
+class ColumnParallelLinear(nn.Module):
+    """Y = X A + b with A split along its output (column) dimension.
+
+    Reference: ``ColumnParallelLinear``. Output is the local shard unless
+    ``gather_output``. With ``sequence_parallel_enabled`` the input arrives
+    sharded along dim 0 (sequence) and is all-gathered in forward /
+    reduce-scattered in backward, per Megatron-SP.
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    gather_output: bool = True
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    gradient_accumulation_fusion: bool = False  # parity; XLA fuses wgrad
+    init_method: Callable = default_init
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tp = parallel_state.get_tensor_model_parallel_world_size()
+        if self.output_size % tp != 0:
+            raise ValueError(
+                f"output_size ({self.output_size}) not divisible by tensor "
+                f"parallel size ({tp})"
+            )
+        local_out = self.output_size // tp
+        kernel = self.param(
+            "kernel",
+            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            (self.input_size, local_out),
+            self.params_dtype,
+        )
+        if self.sequence_parallel_enabled:
+            x = gather_along_first_dim(x)
+        else:
+            x = copy_to_tensor_model_parallel_region(x)
+        y = jnp.matmul(x, kernel.astype(x.dtype))
+        b = None
+        if self.bias:
+            b = self.param(
+                "bias", nn.initializers.zeros, (local_out,), self.params_dtype
+            )
+            if not self.skip_bias_add:
+                y = y + b.astype(y.dtype)
+        if self.gather_output:
+            if self.sequence_parallel_enabled:
+                raise ValueError(
+                    "gather_output is incompatible with sequence_parallel_enabled, "
+                    "matching the reference assertion"
+                )
+            y = gather_from_tensor_model_parallel_region(y)
+        if self.skip_bias_add:
+            return y, b
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    """Y = X A + b with A split along its input (row) dimension.
+
+    Reference: ``RowParallelLinear``. Input is the local shard when
+    ``input_is_parallel`` (the usual case after a ColumnParallelLinear),
+    else scattered here. The partial products are summed with an
+    all-reduce — or a reduce-scatter along the sequence dim under
+    ``sequence_parallel_enabled`` (Megatron-SP's decomposition). Bias is
+    added AFTER the reduction (reference semantics: only once).
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    input_is_parallel: bool = True
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    gradient_accumulation_fusion: bool = False
+    init_method: Callable = default_init
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tp = parallel_state.get_tensor_model_parallel_world_size()
+        if self.input_size % tp != 0:
+            raise ValueError(
+                f"input_size ({self.input_size}) not divisible by tensor "
+                f"parallel size ({tp})"
+            )
+        local_in = self.input_size // tp
+        kernel = self.param(
+            "kernel",
+            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            (local_in, self.output_size),
+            self.params_dtype,
+        )
+        if not self.input_is_parallel:
+            if self.sequence_parallel_enabled:
+                raise ValueError(
+                    "sequence_parallel_enabled requires input_is_parallel, "
+                    "matching the reference assertion"
+                )
+            x = scatter_to_tensor_model_parallel_region(x)
+        y = jnp.matmul(x, kernel.astype(x.dtype))
+        if self.sequence_parallel_enabled:
+            y = reduce_scatter_along_first_dim(y)
+        else:
+            y = reduce_from_tensor_model_parallel_region(y)
+        b = None
+        if self.bias:
+            b = self.param(
+                "bias", nn.initializers.zeros, (self.output_size,), self.params_dtype
+            )
+            if not self.skip_bias_add:
+                y = y + b.astype(y.dtype)
+        if self.skip_bias_add:
+            return y, b
+        return y
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding table split along the vocab dimension.
+
+    Reference: ``VocabParallelEmbedding`` — out-of-range ids are masked to
+    zero locally and the partial lookups are psum'd, so each id resolves on
+    exactly one rank.
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        tp = parallel_state.get_tensor_model_parallel_world_size()
+        rank = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+        if self.num_embeddings % tp != 0:
+            raise ValueError(
+                f"num_embeddings ({self.num_embeddings}) not divisible by "
+                f"tensor parallel size ({tp})"
+            )
+        per = self.num_embeddings // tp
+        table = self.param(
+            "embedding",
+            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            (per, self.embedding_dim),
+            self.params_dtype,
+        )
+        start = rank * per
+        local_ids = ids - start
+        in_range = (local_ids >= 0) & (local_ids < per)
+        safe_ids = jnp.where(in_range, local_ids, 0)
+        out = jnp.take(table, safe_ids, axis=0)
+        out = jnp.where(in_range[..., None], out, 0.0)
+        return reduce_from_tensor_model_parallel_region(out)

@@ -1,0 +1,88 @@
+"""Tensor-parallel communication mappings.
+
+Rebuild of ``apex/transformer/tensor_parallel/mappings.py`` (SURVEY.md
+§2.3): the region mappings of Megatron TP plus the sequence-parallel
+first-dim scatter/gather pair, over the ``tensor`` mesh axis inside
+``shard_map``.
+
+Design note — why there are no custom autograd functions here, unlike the
+reference: the reference implements each mapping as an autograd Function
+(``_CopyToModelParallelRegion`` etc.) because torch cannot know which
+tensors are replicated vs. sharded across ranks. JAX shard_map tracks
+exactly that (the aval's varying-axes set), and its autodiff provides the
+correct transposes natively:
+
+- ``copy``    = mark-varying (``pcast to='varying'``); transpose = psum —
+  precisely the identity-fwd/allreduce-bwd pair.
+- ``reduce``  = ``psum``; transpose = mark-varying (identity values).
+- ``scatter`` = per-rank ``dynamic_slice``; transpose zero-pads the local
+  chunk, and the boundary psum for replicated inputs reassembles the full
+  gradient — the reference's all-gather backward.
+- ``gather``  = ``all_gather``; transpose = reduce-scatter.
+
+Hand-rolling the reference's backward collectives on top of this (as a
+custom_vjp) would DOUBLE-apply the boundary psum for replicated inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.collectives import mark_varying
+
+
+def _axis():
+    return parallel_state.TENSOR_AXIS
+
+
+def _mark_varying(x):
+    return mark_varying(x, _axis())
+
+
+def copy_to_tensor_model_parallel_region(x):
+    """Identity forward, all-reduce backward (reference:
+    ``_CopyToModelParallelRegion``) — the entry mapping of
+    ColumnParallelLinear."""
+    return _mark_varying(x)
+
+
+def reduce_from_tensor_model_parallel_region(x):
+    """All-reduce forward, identity backward (reference:
+    ``_ReduceFromModelParallelRegion``) — the exit mapping of
+    RowParallelLinear."""
+    return jax.lax.psum(x, _axis())
+
+
+def scatter_to_tensor_model_parallel_region(x):
+    """Keep this rank's last-dim chunk (reference:
+    ``_ScatterToModelParallelRegion``); backward reassembles the full
+    gradient."""
+    tp = parallel_state.get_tensor_model_parallel_world_size()
+    rank = jax.lax.axis_index(_axis())
+    chunk = x.shape[-1] // tp
+    return jax.lax.dynamic_slice_in_dim(
+        _mark_varying(x), rank * chunk, chunk, axis=x.ndim - 1
+    )
+
+
+def gather_from_tensor_model_parallel_region(x):
+    """All-gather last-dim chunks (reference:
+    ``_GatherFromModelParallelRegion``); backward keeps this rank's chunk
+    (reduce-scatter transpose)."""
+    return jax.lax.all_gather(x, _axis(), axis=x.ndim - 1, tiled=True)
+
+
+# -- sequence-parallel first-dim pair (SURVEY.md §2.3 SP row) --------------
+
+def reduce_scatter_along_first_dim(x):
+    """reduce-scatter over the sequence dim (reference:
+    ``_reduce_scatter_along_first_dim``) — SP's replacement for the
+    RowParallel exit allreduce; backward all-gathers."""
+    return jax.lax.psum_scatter(x, _axis(), scatter_dimension=0, tiled=True)
+
+
+def gather_along_first_dim(x):
+    """all-gather over the sequence dim (reference:
+    ``_gather_along_first_dim``); backward reduce-scatters."""
+    return jax.lax.all_gather(x, _axis(), axis=0, tiled=True)

@@ -1,0 +1,101 @@
+"""Model-parallel RNG management + activation checkpointing.
+
+Rebuild of ``apex/transformer/tensor_parallel/random.py`` (SURVEY.md §2.3
+/ §5): the reference maintains per-TP-rank CUDA RNG states
+(``CudaRNGStatesTracker``) so dropout inside TP regions differs per rank
+while non-TP regions agree, and a ``checkpoint()`` that replays them for
+activation recompute.
+
+JAX's counter-based PRNG makes both trivial and bitwise-reproducible:
+
+- per-rank streams are ``fold_in(key, tp_rank)`` — no state capture;
+- ``checkpoint`` is ``jax.checkpoint`` (rematerialization): the SAME key
+  reaches the recomputed segment, so dropout masks replay exactly. The
+  reference needs RNG state save/restore precisely because CUDA RNG is
+  stateful; here determinism is structural.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_key(key):
+    """A key decorrelated across TP ranks (dropout inside TP regions)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(parallel_state.TENSOR_AXIS))
+
+
+class RNGStatesTracker:
+    """API-parity port of ``CudaRNGStatesTracker``: named RNG streams.
+
+    ``add(name, seed)`` registers a stream; ``fork(name)`` returns a fresh
+    key from it (advancing a counter — the functional analog of forking
+    the CUDA RNG state and restoring it afterwards).
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, jnp.ndarray] = {}
+        self.counters_: Dict[str, int] = {}
+
+    def reset(self):
+        self.states_.clear()
+        self.counters_.clear()
+
+    def get_states(self):
+        return dict(self.states_), dict(self.counters_)
+
+    def set_states(self, states):
+        self.states_, self.counters_ = dict(states[0]), dict(states[1])
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise RuntimeError(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+        self.counters_[name] = 0
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        if name not in self.states_:
+            raise RuntimeError(f"rng state {name} is not added")
+        key = jax.random.fold_in(self.states_[name], self.counters_[name])
+        self.counters_[name] += 1
+        return key
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """Reference name: ``get_cuda_rng_tracker``."""
+    return _RNG_STATE_TRACKER
+
+
+# torch-name alias for drop-in reading of ported code
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
+def model_parallel_rng_seed(seed: int):
+    """Reference: ``model_parallel_cuda_manual_seed`` — registers the
+    model-parallel stream with a TP-rank offset baked in at fork time."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, seed)
+    return tracker
+
+
+model_parallel_cuda_manual_seed = model_parallel_rng_seed
+
+
+def checkpoint(fn, *args, policy=None, prevent_cse: bool = True):
+    """Activation checkpointing (reference: ``random.checkpoint``): run
+    ``fn`` without saving intermediates; recompute them in backward.
+    ``jax.checkpoint`` replays identical RNG keys, so dropout matches the
+    forward bitwise — the property the reference's RNG fork/restore dance
+    exists to guarantee."""
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)(*args)

@@ -9,6 +9,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def mark_varying(x, axis_names):
+    """Idempotent ``pcast(..., to='varying')`` over a pytree: only axes not
+    already in a leaf's varying set are cast (raw pcast raises on
+    already-varying input)."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+
+    def one(a):
+        try:
+            vma = jax.typeof(a).vma
+        except (AttributeError, TypeError):
+            vma = frozenset()
+        missing = tuple(ax for ax in axis_names if ax not in vma)
+        if not missing:
+            return a
+        return jax.lax.pcast(a, missing, to="varying")
+
+    return jax.tree.map(one, x)
+
+
 def axis_is_bound(axis_name: str):
     """Whether ``axis_name`` is currently a bound collective axis
     (inside shard_map/pmap over it). Returns None if undeterminable on

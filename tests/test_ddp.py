@@ -203,3 +203,24 @@ def test_flat_dist_call():
     )(xs)
     assert float(a[0]) == 28.0  # sum 0..7
     assert float(b[0]) == 56.0
+
+
+def test_mixed_vma_tree_not_double_reduced():
+    """Review regression: an already-summed (unvarying) leaf bucketed with
+    a varying leaf must not be psum'd again."""
+    mesh = _mesh()
+    for delay in (False, True):
+        ddp = DistributedDataParallel(axis_name="data", delay_allreduce=delay)
+
+        def f(x):
+            unvarying = jnp.ones((3,))        # replicated, pre-summed
+            tree = {"u": unvarying, "v": x}   # mixed with varying x
+            return ddp.allreduce_grads(tree)
+
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+        )(jnp.arange(8.0))
+        # unvarying leaf: skip psum, divide by world -> 1/8
+        np.testing.assert_allclose(np.asarray(out["u"]), 0.125, rtol=1e-6)
+        # varying leaf: psum/world = mean = 3.5
+        np.testing.assert_allclose(np.asarray(out["v"]), 3.5, rtol=1e-6)

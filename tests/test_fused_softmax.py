@@ -1,0 +1,140 @@
+"""Fused softmax kernel tests (upstream analog:
+tests/L0/run_transformer/test_fused_softmax.py, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+    softmax_reference,
+)
+from apex_tpu.transformer.functional import AttnMaskType, FusedScaleMaskSoftmax
+
+
+def _x(shape, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype("float32")).astype(dtype)
+
+
+@pytest.mark.parametrize("shape", [(2, 4, 8, 128), (2, 2, 16, 100), (1, 1, 8, 256)])
+def test_scaled_softmax_matches_reference(shape):
+    x = _x(shape)
+    y = scaled_softmax(x, 0.5)
+    ref = softmax_reference(x, scale=0.5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_scaled_masked_softmax_bool_mask():
+    x = _x((2, 4, 8, 64))
+    rng = np.random.RandomState(1)
+    mask = jnp.asarray(rng.rand(2, 1, 8, 64) > 0.7)
+    y = scaled_masked_softmax(x, mask, 2.0)
+    ref = softmax_reference(x, jnp.broadcast_to(mask, x.shape), 2.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    # masked positions ~ 0 probability
+    got = np.asarray(y)
+    assert got[np.broadcast_to(np.asarray(mask), got.shape)].max() < 1e-6
+
+
+def test_scaled_masked_softmax_additive_mask():
+    x = _x((2, 2, 4, 32))
+    mask = jnp.where(_x((2, 1, 4, 32), 3) > 0, 0.0, -1e9).astype(jnp.float32)
+    y = scaled_masked_softmax(x, mask, 1.0)
+    ref = softmax_reference(x, jnp.broadcast_to(mask, x.shape), 1.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("sq", [8, 64, 100])
+def test_causal_softmax(sq):
+    x = _x((2, 2, sq, sq))
+    y = scaled_upper_triang_masked_softmax(x, 1.0)
+    ref = softmax_reference(x, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    got = np.asarray(y)
+    # strictly upper triangle must be ~0
+    iu = np.triu_indices(sq, 1)
+    assert got[..., iu[0], iu[1]].max() < 1e-6
+    # rows sum to 1
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_causal_requires_square():
+    with pytest.raises(ValueError):
+        scaled_upper_triang_masked_softmax(_x((2, 2, 8, 16)))
+
+
+def test_softmax_grads_match_reference():
+    x = _x((2, 2, 8, 64))
+
+    def fused_loss(x):
+        return jnp.sum(jnp.sin(scaled_softmax(x, 1.7)))
+
+    def ref_loss(x):
+        return jnp.sum(jnp.sin(softmax_reference(x, scale=1.7)))
+
+    gf = jax.grad(fused_loss)(x)
+    gr = jax.grad(ref_loss)(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+def test_causal_grads_match_reference():
+    x = _x((1, 2, 32, 32))
+    gf = jax.grad(lambda x: jnp.sum(jnp.sin(scaled_upper_triang_masked_softmax(x, 0.8))))(x)
+    gr = jax.grad(lambda x: jnp.sum(jnp.sin(softmax_reference(x, scale=0.8, causal=True))))(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_io():
+    x = _x((2, 2, 8, 128), dtype=jnp.bfloat16)
+    y = scaled_softmax(x, 1.0)
+    assert y.dtype == jnp.bfloat16
+    ref = softmax_reference(x)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_module_dispatch():
+    sm = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.5)
+    x = _x((2, 4, 16, 16))
+    y = sm(x)
+    ref = softmax_reference(x, scale=0.5, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    sm2 = FusedScaleMaskSoftmax(scaled_masked_softmax_fusion=False)
+    y2 = sm2(x, None)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(softmax_reference(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_module_validation():
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(softmax_in_fp32=False, scale=2.0)
+
+
+def test_causal_with_padding_mask_matches_fallback():
+    """Review regression: the fused causal path must honor a padding mask
+    identically to the non-fused fallback."""
+    x = _x((2, 2, 16, 16))
+    mask = jnp.zeros((2, 1, 16, 16), bool).at[..., -3:].set(True)
+    fused = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.5)
+    slow = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.5,
+                                 scaled_masked_softmax_fusion=False)
+    yf = np.asarray(fused(x, mask))
+    ys = np.asarray(slow(x, jnp.broadcast_to(mask, x.shape)))
+    # padded keys get ~zero probability on both paths
+    assert yf[np.broadcast_to(np.asarray(mask), yf.shape)].max() < 1e-6
+    np.testing.assert_allclose(yf, ys, rtol=1e-4, atol=1e-5)
+
+
+def test_module_handles_2d_and_5d_inputs():
+    sm = FusedScaleMaskSoftmax()
+    y2 = sm(_x((8, 32)))
+    np.testing.assert_allclose(np.asarray(y2.sum(-1)), 1.0, rtol=1e-5)
+    y5 = sm(_x((2, 2, 3, 4, 32)))
+    np.testing.assert_allclose(np.asarray(y5.sum(-1)), 1.0, rtol=1e-5)

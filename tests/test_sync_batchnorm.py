@@ -200,8 +200,9 @@ def test_larc_folds_weight_decay_into_grad():
     pn = np.sqrt(4 * 4.0)  # ||p|| = 4
     gn = np.sqrt(4 * 0.25)  # ||g|| = 1
     adaptive = 0.02 * pn / (gn + 0.5 * pn + 1e-8)
-    # g' = (g + wd*p) * adaptive/lr; inner optimizer runs with wd = 0
-    gprime = (0.5 + 0.5 * 2.0) * (adaptive / 0.1)
+    # reference clip=False: g' = (g + wd*p) * adaptive_lr, inner optimizer
+    # applies lr on top -> step = lr * adaptive * (g + wd*p)
+    gprime = (0.5 + 0.5 * 2.0) * adaptive
     exp = 2.0 - 0.1 * gprime
     np.testing.assert_allclose(np.asarray(p["w"]), exp, rtol=1e-5)
 
