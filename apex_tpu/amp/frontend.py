@@ -121,7 +121,11 @@ _DISALLOWED = {
 # path segments in common flax/haiku naming (BatchNorm_0, LayerNorm, bn1,
 # rmsnorm...). The reference keys off module type (torch BN modules);
 # functionally we key off the param path.
-_NORM_RE = re.compile(r"(?i)(batch|layer|group|rms|sync)?[_]?norm|(^|[._/])bn\d*($|[._/])")
+_NORM_RE = re.compile(
+    r"(?i)(batch|layer|group|rms|sync)?[_]?norm"      # *norm, *_norm
+    r"|(^|[._/])bn\d*($|[._/])"                        # bn, bn1 segments
+    r"|(^|[._/])ln\d*($|[._/])|_ln\d*($|[._/])"        # ln / *_ln segments
+)
 
 
 def _default_norm_filter(path: str) -> bool:

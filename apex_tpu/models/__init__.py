@@ -1,0 +1,6 @@
+from apex_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPreTraining,
+    BertModel,
+    pretraining_loss,
+)

@@ -1,0 +1,344 @@
+"""BERT on apex_tpu building blocks — the north-star flagship model.
+
+The reference ships no models (apex is a library; its BERT lives in the
+NVIDIA DeepLearningExamples MLPerf harness that BASELINE.json's
+``configs[4]`` points at). This module provides the equivalent workload:
+BERT-large pretraining (MLM + NSP) assembled from the framework's own
+pieces — FusedLayerNorm (Pallas), FusedScaleMaskSoftmax (Pallas),
+amp O2 + FusedLAMB + DDP at the training-step level — plus Megatron-style
+TP and sequence parallelism via the tensor_parallel layers for multi-chip
+meshes.
+
+Layout notes (TPU-first): activations are batch-major ``(B, S, H)``;
+under sequence parallelism the per-rank activation is ``(B, S/tp, H)``
+and token-major ``(S, B)`` ordering is used across the first-dim
+gather/reduce-scatter mappings (the reason Megatron is s,b,h internally).
+Matmuls carry ``preferred_element_type=fp32`` so bf16 inputs hit the MXU
+with fp32 accumulation. ``fused_kernels=False`` swaps the Pallas norm/
+softmax for stock flax/jnp ops — the bench baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.functional import AttnMaskType, FusedScaleMaskSoftmax
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # bert-large
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layernorm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.float32   # activation/compute dtype (bf16 for O2)
+    remat: bool = True               # activation checkpointing per layer
+    fused_kernels: bool = True       # Pallas LN/softmax vs stock ops
+    # multi-chip: use tensor_parallel layers (requires bound "tensor" axis)
+    use_tensor_parallel: bool = False
+    sequence_parallel: bool = False
+
+    @staticmethod
+    def bert_large(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def bert_base(**kw):
+        return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                          intermediate_size=3072, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        """Test/dryrun config."""
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return BertConfig(**kw)
+
+
+def _dense(cfg, features, name):
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.initializers.normal(stddev=0.02),
+        name=name,
+    )
+
+
+def _norm(cfg, name):
+    if cfg.fused_kernels:
+        return FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps, name=name)
+    return nn.LayerNorm(epsilon=cfg.layernorm_eps, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name=name)
+
+
+def _attn_softmax(cfg, scores, mask):
+    scale = 1.0
+    if cfg.fused_kernels:
+        return FusedScaleMaskSoftmax(
+            attn_mask_type=AttnMaskType.padding, scale=scale,
+        )(scores, mask)
+    xf = scores.astype(jnp.float32)
+    if mask is not None:
+        xf = jnp.where(mask, -30000.0, xf)
+    return jax.nn.softmax(xf, axis=-1).astype(scores.dtype)
+
+
+# sequence-parallel layout helpers: (B, S_local, H) <-> (S_local*B, H)
+# token-major so first-dim gather/scatter stacks along the sequence.
+
+def _sp_enter(x):
+    return x.transpose(1, 0, 2).reshape(-1, x.shape[-1])
+
+
+def _sp_exit(t, batch):
+    return t.reshape(-1, batch, t.shape[-1]).transpose(1, 0, 2)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic: bool = True):
+        cfg = self.cfg
+        h, nh = cfg.hidden_size, cfg.num_heads
+        hd = h // nh
+        B = x.shape[0]
+        inv_sqrt = 1.0 / (hd ** 0.5)
+
+        if cfg.use_tensor_parallel:
+            from apex_tpu.transformer import parallel_state
+            from apex_tpu.transformer.tensor_parallel import (
+                ColumnParallelLinear,
+                RowParallelLinear,
+            )
+
+            tp = parallel_state.get_tensor_model_parallel_world_size()
+            nh_local, local_h = nh // tp, h // tp
+            t = _sp_enter(x) if cfg.sequence_parallel else x.reshape(-1, h)
+            qkv_t = ColumnParallelLinear(
+                input_size=h, output_size=3 * h, gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="qkv")(t)
+            qkv = (_sp_exit(qkv_t, B) if cfg.sequence_parallel
+                   else qkv_t.reshape(B, -1, 3 * local_h))
+        else:
+            qkv = _dense(cfg, 3 * h, "qkv")(x)
+            nh_local, local_h = nh, h
+
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, -1, nh_local, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                            preferred_element_type=jnp.float32) * inv_sqrt
+        probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
+        probs = nn.Dropout(cfg.attention_dropout)(
+            probs, deterministic=deterministic)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
+                         preferred_element_type=jnp.float32).astype(cfg.dtype)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, -1, local_h)
+
+        if cfg.use_tensor_parallel:
+            from apex_tpu.transformer.tensor_parallel import RowParallelLinear
+
+            t = (_sp_enter(ctx) if cfg.sequence_parallel
+                 else ctx.reshape(-1, local_h))
+            out_t = RowParallelLinear(
+                input_size=h, output_size=h, input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="out")(t)
+            out = (_sp_exit(out_t, B) if cfg.sequence_parallel
+                   else out_t.reshape(B, -1, h))
+        else:
+            out = _dense(cfg, h, "out")(ctx)
+        return out.astype(cfg.dtype)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic: bool = True):
+        cfg = self.cfg
+        B = x.shape[0]
+        attn = BertSelfAttention(cfg, name="attention")(
+            x, attention_mask, deterministic)
+        attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
+        x = _norm(cfg, "attention_ln")(x + attn)
+
+        if cfg.use_tensor_parallel:
+            from apex_tpu.transformer.tensor_parallel import (
+                ColumnParallelLinear,
+                RowParallelLinear,
+            )
+
+            t = _sp_enter(x) if cfg.sequence_parallel else x.reshape(-1, cfg.hidden_size)
+            hmid = ColumnParallelLinear(
+                input_size=cfg.hidden_size, output_size=cfg.intermediate_size,
+                gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="mlp_in")(t)
+            hmid = nn.gelu(hmid)
+            mlp_t = RowParallelLinear(
+                input_size=cfg.intermediate_size, output_size=cfg.hidden_size,
+                input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="mlp_out")(hmid)
+            mlp = (_sp_exit(mlp_t, B) if cfg.sequence_parallel
+                   else mlp_t.reshape(B, -1, cfg.hidden_size)).astype(cfg.dtype)
+        else:
+            hmid = _dense(cfg, cfg.intermediate_size, "mlp_in")(x)
+            hmid = nn.gelu(hmid)
+            mlp = _dense(cfg, cfg.hidden_size, "mlp_out")(hmid)
+        mlp = nn.Dropout(cfg.hidden_dropout)(mlp, deterministic=deterministic)
+        return _norm(cfg, "output_ln")(x + mlp)
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, deterministic: bool = True):
+        cfg = self.cfg
+        if cfg.use_tensor_parallel:
+            from apex_tpu.transformer.tensor_parallel import VocabParallelEmbedding
+
+            word = VocabParallelEmbedding(
+                num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+                name="word_embeddings")(input_ids)
+        else:
+            word = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                            embedding_init=nn.initializers.normal(0.02),
+                            param_dtype=jnp.float32,
+                            name="word_embeddings")(input_ids)
+        S = input_ids.shape[-1]
+        pos = self.param(
+            "position_embeddings", nn.initializers.normal(0.02),
+            (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)[:S]
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                       embedding_init=nn.initializers.normal(0.02),
+                       param_dtype=jnp.float32,
+                       name="token_type_embeddings")(token_type_ids)
+        x = word + pos[None, :, :] + typ
+        x = _norm(cfg, "ln")(x.astype(cfg.dtype))
+        return nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic)
+        # (B, 1, 1, S) boolean: True = masked (reference convention)
+        mask4d = None
+        if attention_mask is not None:
+            mask4d = (attention_mask == 0)[:, None, None, :]
+
+        if cfg.use_tensor_parallel and cfg.sequence_parallel:
+            # shard the sequence across TP ranks between blocks (Megatron-SP)
+            from apex_tpu.transformer import parallel_state
+            from apex_tpu.utils.collectives import mark_varying
+
+            tp = parallel_state.get_tensor_model_parallel_world_size()
+            rank = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+            s_local = x.shape[1] // tp
+            x = jax.lax.dynamic_slice_in_dim(
+                mark_varying(x, parallel_state.TENSOR_AXIS),
+                rank * s_local, s_local, axis=1)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask4d, deterministic)
+
+        if cfg.use_tensor_parallel and cfg.sequence_parallel:
+            from apex_tpu.transformer.tensor_parallel import gather_along_first_dim
+
+            B = x.shape[0]
+            x = _sp_exit(gather_along_first_dim(_sp_enter(x)), B)
+
+        pooled = jnp.tanh(_dense(cfg, cfg.hidden_size, "pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads (the BASELINE configs[4] pretraining objective)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        x, pooled = BertModel(cfg, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        h = _dense(cfg, cfg.hidden_size, "mlm_transform")(x)
+        h = nn.gelu(h)
+        h = _norm(cfg, "mlm_ln")(h)
+        if cfg.use_tensor_parallel:
+            from apex_tpu.transformer.tensor_parallel import ColumnParallelLinear
+
+            # local-vocab-shard logits, consumed by vocab_parallel_cross_entropy
+            mlm_logits = ColumnParallelLinear(
+                input_size=cfg.hidden_size, output_size=cfg.vocab_size,
+                gather_output=False, name="mlm_decoder",
+            )(h.reshape(-1, cfg.hidden_size)).reshape(*h.shape[:-1], -1)
+        else:
+            mlm_logits = _dense(cfg, cfg.vocab_size, "mlm_decoder")(h)
+        nsp_logits = _dense(cfg, 2, "nsp")(pooled)
+        return mlm_logits, nsp_logits
+
+
+def pretraining_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                     mlm_weights=None, vocab_parallel: bool = False):
+    """Masked-LM + next-sentence loss, fp32 (the MLPerf BERT objective).
+
+    ``mlm_labels``: (B, S) with -1 (ignore) elsewhere. With
+    ``vocab_parallel``, ``mlm_logits`` is the local vocab shard and the
+    per-token loss comes from :func:`vocab_parallel_cross_entropy`.
+    """
+    labels = jnp.maximum(mlm_labels, 0)
+    if mlm_weights is None:
+        mlm_weights = (mlm_labels >= 0).astype(jnp.float32)
+    if vocab_parallel:
+        from apex_tpu.transformer.tensor_parallel import (
+            vocab_parallel_cross_entropy,
+        )
+
+        per_token = vocab_parallel_cross_entropy(mlm_logits, labels)
+    else:
+        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        per_token = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mlm_weights.sum(), 1.0)
+    mlm_loss = (per_token * mlm_weights).sum() / denom
+
+    nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
+    nsp_loss = -jnp.take_along_axis(
+        nsp_logp, nsp_labels[:, None], axis=-1).mean()
+    return mlm_loss + nsp_loss

@@ -1,0 +1,46 @@
+"""Shared helpers for the Pallas kernel wrappers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def use_jnp_fallback(*arrays) -> bool:
+    """True when the Pallas interpreter cannot be used: non-TPU backend AND
+    inputs varying over shard_map axes (this JAX version's HLO interpreter
+    mishandles vma inside its internal loops). The jnp fallbacks compute
+    the identical formulas; real TPU always takes the compiled kernels."""
+    if jax.default_backend() == "tpu":
+        return False
+    return any(frozenset(getattr(jax.typeof(a), "vma", ())) for a in arrays if a is not None)
+
+
+def match_vma(cotangent, primal_example):
+    """Align a custom_vjp cotangent's varying-axes set to its primal's.
+
+    Inside ``shard_map``, autodiff inserts boundary psums for primitives
+    automatically, but a custom_vjp bwd rule is on its own: if the
+    incoming gradient varies over more mesh axes than the primal input
+    (e.g. params replicated across ``data`` receiving data-sharded
+    batch gradients), the bwd rule must psum over the extra axes itself.
+    """
+    want = frozenset(getattr(jax.typeof(primal_example), "vma", ()))
+    have = frozenset(getattr(jax.typeof(cotangent), "vma", ()))
+    extra = have - want
+    if extra:
+        cotangent = jax.lax.psum(cotangent, tuple(sorted(extra)))
+    return cotangent
+
+
+def out_struct(shape, dtype, *like):
+    """``ShapeDtypeStruct`` whose varying-axes set is the union of the
+    inputs'. Inside ``shard_map`` with vma checking, pallas_call outputs
+    must declare how they vary across mesh axes; outside, the empty set is
+    accepted and ignored."""
+    vma = frozenset()
+    for r in like:
+        vma |= frozenset(getattr(jax.typeof(r), "vma", ()))
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)

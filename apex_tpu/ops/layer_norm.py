@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.ops._common import out_struct
+
 LANE = 128
 
 
@@ -118,9 +120,12 @@ def _bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, eps, true_h, rms
     xhat = centered * rstd
     wg = g * w
 
-    # dgamma/dbeta partials for this row block
-    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+    # dgamma/dbeta partials for this row block. The output block is 8
+    # sublanes tall (TPU min tile); the partial lives in row 0, rows 1-7
+    # are zero and vanish in the caller's sum.
+    zeros = jnp.zeros((8, x.shape[1]), jnp.float32)
+    dw_ref[:] = zeros.at[0].set(jnp.sum(g * xhat, axis=0))
+    db_ref[:] = zeros.at[0].set(jnp.sum(g, axis=0))
 
     # dx (standard fused layernorm backward)
     c1 = jnp.sum(wg * xhat, axis=1, keepdims=True) / h
@@ -156,7 +161,7 @@ def _pallas_forward(x2, weight, bias, *, eps, true_h, rms):
         grid=(n // br,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((br, hpad), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, hpad), x2.dtype),
+        out_shape=out_struct((n, hpad), x2.dtype, *args),
         interpret=_interpret(),
     )(*args)
 
@@ -178,13 +183,13 @@ def _pallas_backward(g2, x2, weight, *, eps, true_h, rms):
         ],
         out_specs=(
             pl.BlockSpec((br, hpad), lambda i: (i, 0)),
-            pl.BlockSpec((1, hpad), lambda i: (i, 0)),
-            pl.BlockSpec((1, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((8, hpad), lambda i: (i, 0)),
+            pl.BlockSpec((8, hpad), lambda i: (i, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((n, hpad), g2.dtype),
-            jax.ShapeDtypeStruct((grid, hpad), jnp.float32),
-            jax.ShapeDtypeStruct((grid, hpad), jnp.float32),
+            out_struct((n, hpad), g2.dtype, g2, x2, weight),
+            out_struct((grid * 8, hpad), jnp.float32, g2, x2, weight),
+            out_struct((grid * 8, hpad), jnp.float32, g2, x2, weight),
         ),
         interpret=_interpret(),
     )(g2, x2, weight)
@@ -216,12 +221,48 @@ def _prep(x, weight, bias):
 
 
 def _fwd_impl(x, weight, bias, eps, rms):
+    from apex_tpu.ops._common import use_jnp_fallback
+
+    if use_jnp_fallback(x, weight, bias):
+        if rms:
+            return rms_norm_reference(x, weight, eps)
+        return layer_norm_reference(x, weight, bias, eps)
     x2, w2, b2, lead, n, h, hpad = _prep(x, weight, bias)
     y2 = _pallas_forward(x2, w2, b2, eps=eps, true_h=h, rms=rms)
     return y2[:n, :h].reshape(*lead, h)
 
 
+def _bwd_jnp(g, x, weight, eps, rms):
+    """Same math as _bwd_kernel, in plain jnp (interpreter fallback)."""
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    w = weight.astype(jnp.float32)
+    if rms:
+        mean = 0.0
+    else:
+        mean = xf.mean(-1, keepdims=True)
+    centered = xf - mean
+    var = (centered * centered).mean(-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = centered * rstd
+    wg = gf * w
+    c1 = (wg * xhat).mean(-1, keepdims=True)
+    if rms:
+        dx = (wg - xhat * c1) * rstd
+    else:
+        c2 = wg.mean(-1, keepdims=True)
+        dx = (wg - xhat * c1 - c2) * rstd
+    reduce_axes = tuple(range(x.ndim - 1))
+    dw = jnp.sum(gf * xhat, axis=reduce_axes)
+    db = jnp.sum(gf, axis=reduce_axes)
+    return dx.astype(x.dtype), dw, db
+
+
 def _bwd_impl(g, x, weight, eps, rms):
+    from apex_tpu.ops._common import use_jnp_fallback
+
+    if use_jnp_fallback(g, x, weight):
+        return _bwd_jnp(g, x, weight, eps, rms)
     x2, w2, _, lead, n, h, hpad = _prep(x, weight, None)
     g2 = g.reshape(n, h)
     npad = x2.shape[0]
@@ -251,9 +292,15 @@ def _ln_affine_fwd(x, weight, bias, eps, memory_efficient):
 
 
 def _ln_affine_bwd(eps, memory_efficient, res, g):
+    from apex_tpu.ops._common import match_vma
+
     x, weight = res
     dx, dw, db = _bwd_impl(g, x, weight, eps, rms=False)
-    return dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+    return (
+        match_vma(dx, x),
+        match_vma(dw.astype(weight.dtype), weight),
+        match_vma(db.astype(weight.dtype), weight),
+    )
 
 
 fused_layer_norm_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
@@ -274,9 +321,11 @@ def _rms_affine_fwd(x, weight, eps, memory_efficient):
 
 
 def _rms_affine_bwd(eps, memory_efficient, res, g):
+    from apex_tpu.ops._common import match_vma
+
     x, weight = res
     dx, dw, _ = _bwd_impl(g, x, weight, eps, rms=True)
-    return dx, dw.astype(weight.dtype)
+    return match_vma(dx, x), match_vma(dw.astype(weight.dtype), weight)
 
 
 fused_rms_norm_affine.defvjp(_rms_affine_fwd, _rms_affine_bwd)

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.ops._common import out_struct
+
 LANE = 128
 _NEG = -30000.0  # large-negative fill, safe in bf16/fp32 (reference: -10000)
 
@@ -80,7 +82,7 @@ def _pallas_softmax_fwd(x2, *, scale, causal, sq, true_k):
         grid=(n // br,),
         in_specs=[pl.BlockSpec((br, kpad), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((br, kpad), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, kpad), x2.dtype),
+        out_shape=out_struct((n, kpad), x2.dtype, x2),
         interpret=_interpret(),
     )(x2)
 
@@ -96,7 +98,7 @@ def _pallas_softmax_bwd(g2, y2, *, scale):
             pl.BlockSpec((br, kpad), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((br, kpad), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, kpad), g2.dtype),
+        out_shape=out_struct((n, kpad), g2.dtype, g2, y2),
         interpret=_interpret(),
     )(g2, y2)
 
@@ -116,6 +118,10 @@ def _prep(x):
 
 
 def _softmax_impl(x, scale, causal, sq):
+    from apex_tpu.ops._common import use_jnp_fallback
+
+    if use_jnp_fallback(x):
+        return softmax_reference(x, None, scale, causal)
     x2, lead, n, k = _prep(x)
     y2 = _pallas_softmax_fwd(x2, scale=scale, causal=causal, sq=sq, true_k=k)
     return y2[:n, :k].reshape(*lead, k)
@@ -134,10 +140,17 @@ def _fs_fwd(x, scale, causal):
 
 
 def _fs_bwd(scale, causal, y, g):
+    from apex_tpu.ops._common import match_vma, use_jnp_fallback
+
+    if use_jnp_fallback(y, g):
+        yf = y.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        dot = jnp.sum(gf * yf, axis=-1, keepdims=True)
+        return (match_vma((scale * yf * (gf - dot)).astype(g.dtype), y),)
     y2, lead, n, k = _prep(y)
     g2, _, _, _ = _prep(g)
     dx2 = _pallas_softmax_bwd(g2, y2, scale=scale)
-    return (dx2[:n, :k].reshape(*lead, k),)
+    return (match_vma(dx2[:n, :k].reshape(*lead, k), y),)
 
 
 _fused_softmax.defvjp(_fs_fwd, _fs_bwd)

@@ -80,6 +80,8 @@ def _ce_fwd(logits, target, label_smoothing):
 
 
 def _ce_bwd(label_smoothing, fwd_res, g):
+    from apex_tpu.ops._common import match_vma
+
     (exp, global_sumexp, in_range, safe_t, vocab), dtype_sentinel = fwd_res
     dtype = dtype_sentinel.dtype
     softmax = exp / global_sumexp[..., None]
@@ -89,7 +91,7 @@ def _ce_bwd(label_smoothing, fwd_res, g):
         grad = softmax - (1.0 - label_smoothing) * one_hot - label_smoothing / vocab
     else:
         grad = softmax - one_hot
-    return (grad * g[..., None]).astype(dtype), None
+    return match_vma((grad * g[..., None]).astype(dtype), exp), None
 
 
 vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

@@ -1,0 +1,93 @@
+"""Flagship model smoke tests (BASELINE configs[4] shape, tiny sizes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.amp as amp
+from apex_tpu.models import BertConfig, BertForPreTraining, pretraining_loss
+from apex_tpu.optimizers import FusedLAMB
+
+
+def _batch(cfg, B=2, S=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    types = jnp.asarray(rng.randint(0, 2, (B, S)))
+    mask = jnp.ones((B, S), jnp.int32).at[:, -3:].set(0)
+    mlm_labels = jnp.asarray(
+        np.where(rng.rand(B, S) < 0.15, rng.randint(0, cfg.vocab_size, (B, S)), -1))
+    nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+    return ids, types, mask, mlm_labels, nsp
+
+
+def test_forward_shapes():
+    cfg = BertConfig.tiny()
+    model = BertForPreTraining(cfg)
+    ids, types, mask, _, _ = _batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    mlm, nsp = model.apply(params, ids, types, mask)
+    assert mlm.shape == (2, 16, cfg.vocab_size)
+    assert nsp.shape == (2, 2)
+
+
+def test_bf16_training_step_with_amp_o2_and_lamb():
+    """The north-star recipe at tiny scale: amp O2 + FusedLAMB."""
+    cfg = BertConfig.tiny(dtype=jnp.bfloat16)
+    model = BertForPreTraining(cfg)
+    ids, types, mask, mlm_labels, nsp = _batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)["params"]
+
+    opt = FusedLAMB(lr=1e-3)
+    params, opt, handle = amp.initialize(params, opt, opt_level="O2", verbosity=0)
+    # O2: dense kernels bf16, LN params fp32, masters on
+    assert params["bert"]["layer_0"]["attention"]["qkv"]["kernel"].dtype == jnp.bfloat16
+    assert params["bert"]["layer_0"]["attention_ln"]["scale"].dtype == jnp.float32
+    assert opt.master_weights
+    ost = opt.init(params)
+    sst = handle.init_state()
+
+    @jax.jit
+    def step(p, ost, sst):
+        def loss_fn(q):
+            mlm, nspl = model.apply({"params": q}, ids, types, mask)
+            return pretraining_loss(mlm, nspl, mlm_labels, nsp)
+
+        (loss, found), grads = handle.value_and_grad(loss_fn, sst)(p)
+        p2, ost2 = opt.step(grads, ost, p, skip_if=found)
+        return p2, ost2, handle.scalers[0].update(sst, found), loss
+
+    losses = []
+    for _ in range(8):
+        params, ost, sst, loss = step(params, ost, sst)
+        losses.append(float(loss))
+    assert int(ost.step) == 8
+    assert losses[-1] < losses[0]
+
+
+def test_attention_mask_zeroes_padded_attention():
+    cfg = BertConfig.tiny()
+    model = BertForPreTraining(cfg)
+    ids, types, mask, _, _ = _batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    # outputs at non-pad positions must not depend on pad-position ids
+    mlm1, _ = model.apply(params, ids, types, mask)
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 7) % cfg.vocab_size)
+    mlm2, _ = model.apply(params, ids2, types, mask)
+    np.testing.assert_allclose(np.asarray(mlm1[:, :-3]), np.asarray(mlm2[:, :-3]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_rng_and_determinism():
+    cfg = BertConfig.tiny()
+    model = BertForPreTraining(cfg)
+    ids, types, mask, _, _ = _batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    a1, _ = model.apply(params, ids, types, mask, deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+    a2, _ = model.apply(params, ids, types, mask, deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+    a3, _ = model.apply(params, ids, types, mask, deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(a3))
