@@ -3,9 +3,10 @@
 Rebuild of ``apex/multi_tensor_apply/multi_tensor_apply.py`` (SURVEY.md
 §2.1): the thin dispatcher every fused optimizer routes through. The
 reference chunks tensor lists into ``chunk_size``-element pieces and
-launches one CUDA kernel per metadata batch; here the op itself performs
-the flat-buffer fusion (see :mod:`apex_tpu.ops.multi_tensor`), so the
-applier's job reduces to signature parity — call sites written for apex
+launches one CUDA kernel per metadata batch; here the op itself does
+per-leaf fp32 math that XLA fuses (see :mod:`apex_tpu.ops.multi_tensor`),
+so the applier's job reduces to signature parity — call sites written for
+apex
 (``multi_tensor_applier(amp_C.multi_tensor_adam, overflow_buf, lists,
 *args)``) port unchanged.
 

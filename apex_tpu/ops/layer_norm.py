@@ -122,10 +122,11 @@ def _bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, eps, true_h, rms
 
     # dgamma/dbeta partials for this row block. The output block is 8
     # sublanes tall (TPU min tile); the partial lives in row 0, rows 1-7
-    # are zero and vanish in the caller's sum.
-    zeros = jnp.zeros((8, x.shape[1]), jnp.float32)
-    dw_ref[:] = zeros.at[0].set(jnp.sum(g * xhat, axis=0))
-    db_ref[:] = zeros.at[0].set(jnp.sum(g, axis=0))
+    # are zero and vanish in the caller's sum. Written as an iota row-mask
+    # rather than `.at[0].set` — Mosaic has no scatter lowering.
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, x.shape[1]), 0)
+    dw_ref[:] = jnp.where(row == 0, jnp.sum(g * xhat, axis=0, keepdims=True), 0.0)
+    db_ref[:] = jnp.where(row == 0, jnp.sum(g, axis=0, keepdims=True), 0.0)
 
     # dx (standard fused layernorm backward)
     c1 = jnp.sum(wg * xhat, axis=1, keepdims=True) / h

@@ -4,13 +4,25 @@ Rebuild of the reference's ``csrc/multi_tensor_*.cu`` family (SURVEY.md
 §2.2): one fused pass over *lists* of tensors for scaling/unscaling with
 inf/nan detection, L2 norms, and every optimizer update.
 
-TPU design: instead of chunking device pointers into kernel-arg structs
-(the CUDA ``multi_tensor_apply.cuh`` mechanism: ≤36 tensor addrs per
-launch, 320 blocks), each parallel tensor-list is raveled into ONE
-contiguous fp32 working buffer and the whole elementwise update chain runs
-as a single XLA fusion over it. That is the TPU analog of apex's
-one-launch-per-chunk property: O(1) dispatches per step regardless of the
-number of parameter tensors, HBM-bandwidth-bound, MXU-free.
+TPU design: the CUDA ``multi_tensor_apply.cuh`` mechanism (chunking device
+pointers into kernel-arg structs, ≤36 tensor addrs per launch, 320 blocks)
+exists to amortize *kernel-launch* overhead, which has no analog under
+XLA: everything below lives inside one jitted step, so the elementwise
+update chain for every leaf fuses into a handful of HBM-bandwidth-bound
+kernels with zero dispatch overhead regardless of the number of parameter
+tensors. The math is therefore done **per leaf, in the leaf's natural
+shape** (fp32 working precision):
+
+- Model leaves are naturally 2-D matrices — already tile-friendly for the
+  TPU's (8, 128) layout.
+- An earlier design raveled every list into one giant 1-D fp32 buffer
+  ("flat-buffer" analog of ``apex_C.flatten``). That was a mistake on real
+  hardware: XLA horizontally packs the paired elementwise output streams
+  (e.g. Adam's m/v EMAs) of huge same-shaped 1-D values into an ``[N, 2]``
+  op, and the TPU tiled layout pads the size-2 minor dimension to 128 — a
+  64x memory blowup (a 94 GB allocation at BERT-large scale). The flat
+  concat also costs a full extra HBM round-trip per list per call. Per-leaf
+  avoids both; XLA still fuses each leaf's chain into one pass.
 
 Per-tensor semantics (LAMB trust ratios, NovoGrad per-layer moments) use
 per-leaf reductions; XLA concatenates these small reductions into a
@@ -27,33 +39,33 @@ flag.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.utils.pytree import ravel_list, unravel_list
-
 Array = jax.Array
 
 
-def _fuse(tensors: Sequence[Array]):
-    """Ravel a tensor list into one fp32 working buffer + metadata."""
-    flat, meta = ravel_list(tensors)
-    return flat.astype(jnp.float32), meta
+def _f32(t: Array) -> Array:
+    return t.astype(jnp.float32)
 
 
-def _split(flat: Array, meta):
-    """Split a working buffer back into leaf shapes WITHOUT casting: the
-    fp32 working precision must survive until the final per-output cast
-    (a premature cast through a low-precision input dtype would round away
-    master-weight updates)."""
-    out = []
-    offset = 0
-    for shape, _dtype, size in meta:
-        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
-        offset += size
-    return out
+def _check_parallel(tensor_lists) -> None:
+    """Parallel tensor lists must have equal length (the flat-buffer design
+    failed loudly on mismatch; per-leaf zips would truncate silently)."""
+    lengths = {len(l) for l in tensor_lists}
+    if len(lengths) > 1:
+        raise ValueError(
+            f"parallel tensor lists have mismatched lengths: "
+            f"{[len(l) for l in tensor_lists]}")
+
+
+def _all_finite(leaves: Sequence[Array]):
+    """One bool: every element of every leaf is finite (vacuously True)."""
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([jnp.all(jnp.isfinite(t)) for t in leaves]).all()
 
 
 def _apply_noop(noop_flag, new_lists, old_lists):
@@ -75,12 +87,13 @@ def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
     Reference: ``amp_C.multi_tensor_scale`` — the hot op of loss unscaling
     (SURVEY.md §3.2). Returns ``(out_list, noop_flag_out)``.
     """
-    (src,), out_dtypes = (tensor_lists[0],), [t.dtype for t in tensor_lists[-1]]
-    flat, meta = _fuse(src)
-    scaled = flat * jnp.float32(scale)
-    found = jnp.logical_not(jnp.all(jnp.isfinite(scaled)))
+    _check_parallel(tensor_lists)
+    src = tensor_lists[0]
+    out_dtypes = [t.dtype for t in tensor_lists[-1]]
+    scaled = [_f32(t) * jnp.float32(scale) for t in src]
+    found = jnp.logical_not(_all_finite(scaled))
     flag_out = found if noop_flag is None else jnp.logical_or(noop_flag, found)
-    outs = [o.astype(d) for o, d in zip(_split(scaled, meta), out_dtypes)]
+    outs = [o.astype(d) for o, d in zip(scaled, out_dtypes)]
     if noop_flag is not None:
         outs = [jnp.where(noop_flag, s.astype(d), o)
                 for s, o, d in zip(src, outs, out_dtypes)]
@@ -89,14 +102,14 @@ def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
 
 def multi_tensor_axpby(chunk_size, noop_flag, tensor_lists, a, b):
     """out = a*x + b*y over parallel lists (``amp_C.multi_tensor_axpby``)."""
+    _check_parallel(tensor_lists)
     x_list, y_list = tensor_lists[0], tensor_lists[1]
     out_dtypes = [t.dtype for t in tensor_lists[-1]]
-    fx, meta = _fuse(x_list)
-    fy, _ = _fuse(y_list)
-    out = jnp.float32(a) * fx + jnp.float32(b) * fy
-    found = jnp.logical_not(jnp.all(jnp.isfinite(out)))
+    out = [jnp.float32(a) * _f32(x) + jnp.float32(b) * _f32(y)
+           for x, y in zip(x_list, y_list)]
+    found = jnp.logical_not(_all_finite(out))
     flag_out = found if noop_flag is None else jnp.logical_or(noop_flag, found)
-    outs = [o.astype(d) for o, d in zip(_split(out, meta), out_dtypes)]
+    outs = [o.astype(d) for o, d in zip(out, out_dtypes)]
     (outs,) = _apply_noop(noop_flag, [outs], [tensor_lists[-1]])
     return outs, flag_out
 
@@ -106,10 +119,10 @@ def multi_tensor_l2norm(chunk_size, noop_flag, tensor_lists, per_tensor=False):
     (``amp_C.multi_tensor_l2norm``; feeds LAMB stage 1 and clip_grad).
 
     Per-tensor squared norms are small per-leaf reductions; the global norm
-    is their sum — all fused by XLA into one pass over the flat data.
+    is their sum — all fused by XLA into one pass over the data.
     """
     tensors = tensor_lists[0]
-    sq = jnp.stack([jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors])
+    sq = jnp.stack([jnp.sum(jnp.square(_f32(t))) for t in tensors])
     global_norm = jnp.sqrt(jnp.sum(sq))
     if per_tensor:
         return global_norm, jnp.sqrt(sq)
@@ -144,15 +157,12 @@ def multi_tensor_adam(
     Returns ``([new_params, new_m, new_v] (+ [new_master]), )`` in fp32
     working precision cast back to the input dtypes.
     """
+    _check_parallel(tensor_lists)
     has_master = len(tensor_lists) == 5
     g_list, p_list, m_list, v_list = tensor_lists[:4]
     master_list = tensor_lists[4] if has_master else None
-
-    g, meta = _fuse(g_list)
     # With master weights, the fp32 master buffer is the source of truth.
-    p, _ = _fuse(master_list if has_master else p_list)
-    m, _ = _fuse(m_list)
-    v, _ = _fuse(v_list)
+    src_list = master_list if has_master else p_list
 
     if bias_correction:
         bc1 = 1.0 - beta1 ** step
@@ -160,26 +170,30 @@ def multi_tensor_adam(
     else:
         bc1 = bc2 = 1.0
 
-    if mode == ADAM_MODE_L2 and weight_decay != 0.0:
-        g = g + weight_decay * p
+    new_p, new_m, new_v, new_master = [], [], [], []
+    for i in range(len(g_list)):
+        g = _f32(g_list[i])
+        p = _f32(src_list[i])
+        m = _f32(m_list[i])
+        v = _f32(v_list[i])
+        if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+            g = g + weight_decay * p
+        m = beta1 * m + (1.0 - beta1) * g
+        v = beta2 * v + (1.0 - beta2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
+            update = update + weight_decay * p
+        stepped = p - lr * update
+        new_p.append(stepped.astype(p_list[i].dtype))
+        new_m.append(m.astype(m_list[i].dtype))
+        new_v.append(v.astype(v_list[i].dtype))
+        if has_master:
+            new_master.append(stepped.astype(master_list[i].dtype))
 
-    m = beta1 * m + (1.0 - beta1) * g
-    v = beta2 * v + (1.0 - beta2) * g * g
-    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-    if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
-        update = update + weight_decay * p
-    p_new = p - lr * update
-
-    def cast_like(flat, ref_list):
-        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
-
-    new_p = cast_like(p_new, p_list)
-    new_m = cast_like(m, m_list)
-    new_v = cast_like(v, v_list)
     old = [p_list, m_list, v_list]
     new = [new_p, new_m, new_v]
     if has_master:
-        new.append(cast_like(p_new, master_list))
+        new.append(new_master)
         old.append(master_list)
     return _apply_noop(noop_flag, new, old)
 
@@ -188,26 +202,32 @@ def multi_tensor_adagrad(chunk_size, noop_flag, tensor_lists, lr, eps, mode, wei
     """Fused Adagrad over [grads, params, state_sums]
     (+ optional trailing fp32 master-param list)
     (``amp_C.multi_tensor_adagrad``)."""
+    _check_parallel(tensor_lists)
     has_master = len(tensor_lists) == 4
     g_list, p_list, h_list = tensor_lists[:3]
     master_list = tensor_lists[3] if has_master else None
-    g, meta = _fuse(g_list)
-    p, _ = _fuse(master_list if has_master else p_list)
-    h, _ = _fuse(h_list)
-    if mode == ADAM_MODE_L2 and weight_decay != 0.0:
-        g = g + weight_decay * p
-    h = h + g * g
-    p_new = p - lr * g / (jnp.sqrt(h) + eps)
-    if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
-        p_new = p_new - lr * weight_decay * p
+    src_list = master_list if has_master else p_list
 
-    def cast_like(flat, ref_list):
-        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
+    new_p, new_h, new_master = [], [], []
+    for i in range(len(g_list)):
+        g = _f32(g_list[i])
+        p = _f32(src_list[i])
+        h = _f32(h_list[i])
+        if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+            g = g + weight_decay * p
+        h = h + g * g
+        stepped = p - lr * g / (jnp.sqrt(h) + eps)
+        if mode == ADAM_MODE_ADAMW and weight_decay != 0.0:
+            stepped = stepped - lr * weight_decay * p
+        new_p.append(stepped.astype(p_list[i].dtype))
+        new_h.append(h.astype(h_list[i].dtype))
+        if has_master:
+            new_master.append(stepped.astype(master_list[i].dtype))
 
-    new = [cast_like(p_new, p_list), cast_like(h, h_list)]
+    new = [new_p, new_h]
     old = [p_list, h_list]
     if has_master:
-        new.append(cast_like(p_new, master_list))
+        new.append(new_master)
         old.append(master_list)
     return _apply_noop(noop_flag, new, old)
 
@@ -235,37 +255,42 @@ def multi_tensor_sgd(
     Mirrors the reference kernel's knobs: nesterov, dampening,
     wd_after_momentum, grad pre-scale, and first_run momentum init.
     """
+    _check_parallel(tensor_lists)
     has_master = len(tensor_lists) == 4
     g_list, p_list, mom_list = tensor_lists[:3]
     master_list = tensor_lists[3] if has_master else None
+    src_list = master_list if has_master else p_list
 
-    g, meta = _fuse(g_list)
-    p, _ = _fuse(master_list if has_master else p_list)
-    mom, _ = _fuse(mom_list)
+    new_p, new_mom, new_master = [], [], []
+    for i in range(len(g_list)):
+        g = _f32(g_list[i]) * jnp.float32(scale)
+        p = _f32(src_list[i])
+        mom = _f32(mom_list[i])
 
-    g = g * jnp.float32(scale)
-    if weight_decay != 0.0 and not wd_after_momentum:
-        g = g + weight_decay * p
+        if weight_decay != 0.0 and not wd_after_momentum:
+            g = g + weight_decay * p
 
-    if momentum != 0.0:
-        mom_new = jnp.where(jnp.bool_(first_run), g, momentum * mom + (1.0 - dampening) * g)
-        d = g + momentum * mom_new if nesterov else mom_new
-    else:
-        mom_new = mom
-        d = g
+        if momentum != 0.0:
+            mom_new = jnp.where(
+                jnp.bool_(first_run), g, momentum * mom + (1.0 - dampening) * g)
+            d = g + momentum * mom_new if nesterov else mom_new
+        else:
+            mom_new = mom
+            d = g
 
-    if weight_decay != 0.0 and wd_after_momentum:
-        d = d + weight_decay * p
+        if weight_decay != 0.0 and wd_after_momentum:
+            d = d + weight_decay * p
 
-    p_new = p - lr * d
+        stepped = p - lr * d
+        new_p.append(stepped.astype(p_list[i].dtype))
+        new_mom.append(mom_new.astype(mom_list[i].dtype))
+        if has_master:
+            new_master.append(stepped.astype(master_list[i].dtype))
 
-    def cast_like(flat, ref_list):
-        return [o.astype(t.dtype) for o, t in zip(_split(flat, meta), ref_list)]
-
-    new = [cast_like(p_new, p_list), cast_like(mom_new, mom_list)]
+    new = [new_p, new_mom]
     old = [p_list, mom_list]
     if has_master:
-        new.append(cast_like(p_new, master_list))
+        new.append(new_master)
         old.append(master_list)
     return _apply_noop(noop_flag, new, old)
 
@@ -284,6 +309,7 @@ def multi_tensor_lamb_stage1(
 
     Returns ``(update_list, new_m_list, new_v_list)`` in fp32.
     """
+    _check_parallel(tensor_lists)
     g_list, p_list, m_list, v_list = tensor_lists
 
     clip = jnp.where(
@@ -299,19 +325,19 @@ def multi_tensor_lamb_stage1(
         bc1 = bc2 = 1.0
     beta3 = (1.0 - beta1) if grad_averaging else 1.0
 
-    g, meta = _fuse(g_list)
-    p, _ = _fuse(p_list)
-    m, _ = _fuse(m_list)
-    v, _ = _fuse(v_list)
-
-    g = g * clip
-    m = beta1 * m + beta3 * g
-    v = beta2 * v + (1.0 - beta2) * g * g
-    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-    if weight_decay != 0.0:
-        update = update + weight_decay * p
-
-    return _split(update, meta), _split(m, meta), _split(v, meta)
+    updates, new_m, new_v = [], [], []
+    for g, p, m, v in zip(g_list, p_list, m_list, v_list):
+        g32 = _f32(g) * clip
+        p32 = _f32(p)
+        m32 = beta1 * _f32(m) + beta3 * g32
+        v32 = beta2 * _f32(v) + (1.0 - beta2) * g32 * g32
+        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if weight_decay != 0.0:
+            update = update + weight_decay * p32
+        updates.append(update)
+        new_m.append(m32)
+        new_v.append(v32)
+    return updates, new_m, new_v
 
 
 def multi_tensor_lamb_stage2(
@@ -326,6 +352,7 @@ def multi_tensor_lamb_stage2(
 
     tensor_lists = [params, updates] (+ optional fp32 master list).
     """
+    _check_parallel(tensor_lists)
     has_master = len(tensor_lists) == 3
     p_list, u_list = tensor_lists[:2]
     master_list = tensor_lists[2] if has_master else None
@@ -334,8 +361,8 @@ def multi_tensor_lamb_stage2(
 
     new_p, new_master = [], []
     for i, (p, u) in enumerate(zip(src_list, u_list)):
-        p32 = p.astype(jnp.float32)
-        u32 = u.astype(jnp.float32)
+        p32 = _f32(p)
+        u32 = _f32(u)
         if apply_ratio:
             w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
             u_norm = jnp.sqrt(jnp.sum(jnp.square(u32)))
@@ -368,6 +395,8 @@ def multi_tensor_novograd(
     with the first step's squared gradient norms.
     Returns ``(new_params, new_m, new_v[, new_master])``.
     """
+    # tensor_lists[3] (per-tensor v) is a stacked vector, not a list
+    _check_parallel(tensor_lists[:3] + (tensor_lists[4:] if len(tensor_lists) == 5 else []))
     has_master = len(tensor_lists) == 5
     g_list, p_list, m_list = tensor_lists[:3]
     v = tensor_lists[3]  # stacked per-tensor second moments, shape (n,)
@@ -382,7 +411,7 @@ def multi_tensor_novograd(
     beta3 = (1.0 - beta1) if grad_averaging else 1.0
 
     g_norms = jnp.stack(
-        [jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))) for g in g_list]
+        [jnp.sqrt(jnp.sum(jnp.square(_f32(g)))) for g in g_list]
     )
     ema = beta2 * v + (1.0 - beta2) * g_norms ** 2
     if init_zero:
@@ -393,11 +422,11 @@ def multi_tensor_novograd(
 
     new_p, new_m, new_master = [], [], []
     for i, (g, p, m) in enumerate(zip(g_list, src_list, m_list)):
-        p32 = p.astype(jnp.float32)
-        g32 = g.astype(jnp.float32) / denom[i]
+        p32 = _f32(p)
+        g32 = _f32(g) / denom[i]
         if weight_decay != 0.0:
             g32 = g32 + weight_decay * p32
-        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+        m32 = beta1 * _f32(m) + beta3 * g32
         upd = m32 / bc1
         stepped = p32 - lr * upd
         new_p.append(stepped.astype(p_list[i].dtype))

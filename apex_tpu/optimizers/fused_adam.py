@@ -2,8 +2,9 @@
 
 Rebuild of ``apex/optimizers/fused_adam.py`` + ``csrc/multi_tensor_adam.cu``
 (SURVEY.md §3.3): the entire Adam/AdamW update for every parameter tensor
-runs as one ``multi_tensor_adam`` flat-buffer fusion — the TPU analog of
-the reference's one-kernel-launch step. Knob parity: ``bias_correction``,
+runs as one ``multi_tensor_adam`` call — per-leaf fp32 math that XLA fuses
+into a handful of HBM-bound passes inside the jitted step, the TPU analog
+of the reference's one-kernel-launch step. Knob parity: ``bias_correction``,
 ``betas``, ``eps``, ``adam_w_mode``, ``weight_decay``, ``amsgrad``
 (rejected, like the reference), ``master_weights`` (fp32 masters for amp
 O2), ``capturable`` (accepted and ignored: every jitted step is

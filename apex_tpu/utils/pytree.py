@@ -2,10 +2,12 @@
 
 TPU-native replacement for the reference's ``apex_C`` extension
 (``csrc/flatten_unflatten.cpp``, SURVEY.md §2.2): flattening a list of
-tensors into one contiguous buffer and back. Under XLA this is a
-``concatenate`` of raveled leaves — the compiler fuses the elementwise work
-that follows into a single pass over the buffer, which is the TPU analog of
-apex's one-kernel-launch-per-chunk ``multi_tensor_apply``.
+tensors into one contiguous buffer and back. Used for *communication*
+buffers (DDP bucket allreduce), where one contiguous collective is the
+point. Do NOT use it as a compute-fusion device: huge raveled 1-D buffers
+interact badly with the TPU tiled layout (see the horizontal-packing
+pathology documented in :mod:`apex_tpu.ops.multi_tensor`, which does
+per-leaf math for exactly that reason).
 """
 
 from __future__ import annotations

@@ -48,21 +48,40 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
     ost = opt.init(params)
     sst = handle.init_state()
 
+    # The "fp32 unfused" baseline must do true fp32 matmul math: on TPU the
+    # default matmul precision computes fp32 matmuls on the MXU in bf16
+    # passes, which would silently hand the baseline the optimized path's
+    # main speed advantage (this is the eager-fp32-torch analog the
+    # reference's value-add is measured against).
+    precision = "highest" if opt_level == "O0" else "default"
+
     def step(params, ost, sst):
-        def loss_fn(p):
-            mlm, nsp = model.apply({"params": p}, ids, types, attn)
-            return pretraining_loss(mlm, nsp, mlm_labels, nsp_labels)
+        with jax.default_matmul_precision(precision):
+            def loss_fn(p):
+                mlm, nsp = model.apply({"params": p}, ids, types, attn)
+                return pretraining_loss(mlm, nsp, mlm_labels, nsp_labels)
 
-        (loss, found), grads = handle.value_and_grad(loss_fn, sst)(params)
-        p2, ost2 = opt.step(grads, ost, params, skip_if=found)
-        return p2, ost2, handle.scalers[0].update(sst, found), loss
+            (loss, found), grads = handle.value_and_grad(loss_fn, sst)(params)
+            p2, ost2 = opt.step(grads, ost, params, skip_if=found)
+            return p2, ost2, handle.scalers[0].update(sst, found), loss
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
-    return jitted, (params, ost, sst)
+    # NOTE: no donate_argnums — buffer donation triggers a runtime
+    # INVALID_ARGUMENT on the axon PJRT backend at any scale (verified in
+    # round 1). Donation would halve optimizer-state peak memory; revisit
+    # when the runtime supports it.
+    jitted = jax.jit(step)
+    model_info = dict(
+        n_params=sum(x.size for x in jax.tree.leaves(params)),
+        n_layers=cfg.num_layers, hidden=cfg.hidden_size)
+    # The state is returned in a single-element list so time_steps can POP
+    # it: without buffer donation (unsupported on axon), any lingering
+    # caller reference to the initial 5 GB state tuple keeps it alive for
+    # the whole timing loop and OOMs the 16 GB chip at step 1.
+    return jitted, [(params, ost, sst)], model_info
 
 
-def time_steps(jitted, state, warmup=2, iters=8):
-    params, ost, sst = state
+def time_steps(jitted, state_box, warmup=2, iters=8):
+    params, ost, sst = state_box.pop()  # take ownership; see build_step
     for _ in range(warmup):
         params, ost, sst, loss = jitted(params, ost, sst)
     jax.block_until_ready(loss)
@@ -74,23 +93,45 @@ def time_steps(jitted, state, warmup=2, iters=8):
     return dt, float(loss)
 
 
+def model_flops_per_step(n_params, batch, seq, n_layers, hidden):
+    """Approximate model FLOPs for one fwd+bwd step: 6*N per token for the
+    matmul-dominated path plus the attention score/context term
+    (12 * L * B * S^2 * H, fwd+bwd)."""
+    matmul = 6.0 * n_params * batch * seq
+    attn = 12.0 * n_layers * batch * seq * seq * hidden
+    return matmul + attn
+
+
+def peak_flops():
+    """Peak bf16 FLOP/s of the attached chip (v5e default)."""
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12  # v5e / v5 lite
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
-    batch, seq = (8, 128) if on_tpu else (2, 32)
+    batch, seq = (64, 128) if on_tpu else (2, 32)
 
     # optimized: bf16 O2 + Pallas kernels
-    jitted, state = build_step(
+    jitted, state, info = build_step(
         dict(dtype=jnp.bfloat16, fused_kernels=True), "O2", batch, seq)
     dt_opt, loss_opt = time_steps(jitted, state)
     del jitted, state
 
     # baseline: fp32, stock ops, no amp
-    jitted, state = build_step(
+    jitted, state, _ = build_step(
         dict(dtype=jnp.float32, fused_kernels=False), "O0", batch, seq)
     dt_base, loss_base = time_steps(jitted, state, warmup=2, iters=4)
     del jitted, state
 
     samples_per_sec = batch / dt_opt
+    mfu = model_flops_per_step(
+        info["n_params"], batch, seq, info["n_layers"], info["hidden"],
+    ) / dt_opt / peak_flops()
     result = {
         "metric": "bert_large_pretrain_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 3),
@@ -100,9 +141,10 @@ def main():
     print(json.dumps(result))
     print(
         f"# optimized(bf16 O2+fused): {dt_opt*1e3:.1f} ms/step "
-        f"(loss {loss_opt:.3f}) | baseline(fp32 unfused): "
+        f"(loss {loss_opt:.3f}) MFU={mfu:.3f} | baseline(fp32 unfused): "
         f"{dt_base*1e3:.1f} ms/step (loss {loss_base:.3f}) | "
-        f"batch={batch} seq={seq} backend={jax.default_backend()}",
+        f"batch={batch} seq={seq} params={info['n_params']/1e6:.0f}M "
+        f"backend={jax.default_backend()}",
         file=sys.stderr,
     )
 
