@@ -396,7 +396,7 @@ def multi_tensor_novograd(
     Returns ``(new_params, new_m, new_v[, new_master])``.
     """
     # tensor_lists[3] (per-tensor v) is a stacked vector, not a list
-    _check_parallel(tensor_lists[:3] + (tensor_lists[4:] if len(tensor_lists) == 5 else []))
+    _check_parallel(list(tensor_lists[:3]) + list(tensor_lists[4:]))
     has_master = len(tensor_lists) == 5
     g_list, p_list, m_list = tensor_lists[:3]
     v = tensor_lists[3]  # stacked per-tensor second moments, shape (n,)
