@@ -46,6 +46,12 @@ class BertConfig:
     dtype: jnp.dtype = jnp.float32   # activation/compute dtype (bf16 for O2)
     remat: bool = True               # activation checkpointing per layer
     fused_kernels: bool = True       # Pallas LN/softmax vs stock ops
+    # Pallas flash attention (reference: contrib fmha). Used when the
+    # sequence is long enough to win (>= flash_min_seq; measured v5e
+    # crossover) and attention dropout is inactive (the composed-softmax
+    # path covers training-time attention dropout).
+    flash_attention: bool = True
+    flash_min_seq: int = 256
     # multi-chip: use tensor_parallel layers (requires bound "tensor" axis)
     use_tensor_parallel: bool = False
     sequence_parallel: bool = False
@@ -148,14 +154,33 @@ class BertSelfAttention(nn.Module):
             return t.reshape(B, -1, nh_local, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
-                            preferred_element_type=jnp.float32) * inv_sqrt
-        probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
-        probs = nn.Dropout(cfg.attention_dropout)(
-            probs, deterministic=deterministic)
-        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
-                         preferred_element_type=jnp.float32).astype(cfg.dtype)
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, -1, local_h)
+
+        use_flash = (
+            cfg.fused_kernels and cfg.flash_attention
+            and q.shape[2] >= cfg.flash_min_seq
+            and (cfg.attention_dropout == 0.0 or deterministic)
+            # flash takes a per-key padding mask; the (B, 1, 1, Sk)
+            # convention from BertModel reduces to it exactly
+            and (attention_mask is None
+                 or (attention_mask.ndim == 4
+                     and attention_mask.shape[1] == 1
+                     and attention_mask.shape[2] == 1))
+        )
+        if use_flash:
+            from apex_tpu.ops.flash_attention import flash_attention
+
+            key_mask = (None if attention_mask is None
+                        else attention_mask[:, 0, 0, :])
+            ctx = flash_attention(q, k, v, key_mask, False, inv_sqrt)
+        else:
+            scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                                preferred_element_type=jnp.float32) * inv_sqrt
+            probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
+            probs = nn.Dropout(cfg.attention_dropout)(
+                probs, deterministic=deterministic)
+            ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
+                             preferred_element_type=jnp.float32)
+        ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, -1, local_h)
 
         if cfg.use_tensor_parallel:
             from apex_tpu.transformer.tensor_parallel import RowParallelLinear

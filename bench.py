@@ -84,11 +84,15 @@ def time_steps(jitted, state_box, warmup=2, iters=8):
     params, ost, sst = state_box.pop()  # take ownership; see build_step
     for _ in range(warmup):
         params, ost, sst, loss = jitted(params, ost, sst)
-    jax.block_until_ready(loss)
+    # Block on the FULL output tree: on this runtime individual buffers
+    # become ready as they are produced, and `loss` only depends on the
+    # forward pass — blocking on it alone under-measures the step by the
+    # entire backward + optimizer tail (observed 35x at S=512).
+    jax.block_until_ready((params, ost, sst, loss))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, ost, sst, loss = jitted(params, ost, sst)
-    jax.block_until_ready(loss)
+    jax.block_until_ready((params, ost, sst, loss))
     dt = (time.perf_counter() - t0) / iters
     return dt, float(loss)
 

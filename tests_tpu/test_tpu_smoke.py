@@ -176,3 +176,36 @@ def test_multi_tensor_ops_on_chip():
         ADAM_MODE_ADAMW, True, 0.0))(g, ts, m, v)
     for a, b in zip(p2, ts):
         assert _max_err(a, b) > 1e-5  # params moved
+
+
+@pytest.mark.parametrize("shape,causal,use_mask", [
+    ((2, 4, 128, 64), False, True),
+    ((1, 2, 512, 64), False, True),
+    ((1, 2, 640, 64), True, False),      # multi-block online softmax
+    ((1, 1, 100, 64), False, True),      # unaligned
+])
+def test_flash_attention_fwd_bwd_on_chip(shape, causal, use_mask):
+    from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+    B, H, S, D = shape
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.bfloat16)
+    km = ((jax.random.uniform(jax.random.PRNGKey(9), (B, S)) < 0.3)
+          if use_mask else None)
+    scale = 1.0 / np.sqrt(D)
+
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, km, causal, scale))(
+        q, k, v)
+    ref = mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), km, causal, scale)
+    assert _max_err(out, ref) < 0.02
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, km, causal, scale)
+                       .astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for a in g:
+        assert bool(jnp.all(jnp.isfinite(a.astype(jnp.float32))))
