@@ -77,12 +77,15 @@ class BertConfig:
         return BertConfig(**kw)
 
 
+_BERT_INIT = nn.initializers.normal(stddev=0.02)
+
+
 def _dense(cfg, features, name):
     return nn.Dense(
         features,
         dtype=cfg.dtype,
         param_dtype=jnp.float32,
-        kernel_init=nn.initializers.normal(stddev=0.02),
+        kernel_init=_BERT_INIT,
         name=name,
     )
 
@@ -104,6 +107,30 @@ def _attn_softmax(cfg, scores, mask):
     if mask is not None:
         xf = jnp.where(mask, -30000.0, xf)
     return jax.nn.softmax(xf, axis=-1).astype(scores.dtype)
+
+
+class _TPDropout(nn.Module):
+    """Dropout whose key folds in the TP rank when the activation is
+    sharded over the tensor axis (reference: CudaRNGStatesTracker — TP
+    regions draw from the per-rank model-parallel stream so masks
+    decorrelate; replicated regions keep the shared stream so all ranks
+    apply the identical mask)."""
+
+    rate: float
+    tp_varying: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        if deterministic or self.rate == 0.0:
+            return x
+        key = self.make_rng("dropout")
+        if self.tp_varying:
+            from apex_tpu.transformer.tensor_parallel.random import (
+                model_parallel_key,
+            )
+
+            key = model_parallel_key(key)
+        return nn.Dropout(self.rate)(x, deterministic=False, rng=key)
 
 
 # sequence-parallel layout helpers: (B, S_local, H) <-> (S_local*B, H)
@@ -141,7 +168,7 @@ class BertSelfAttention(nn.Module):
             qkv_t = ColumnParallelLinear(
                 input_size=h, output_size=3 * h, gather_output=False,
                 sequence_parallel_enabled=cfg.sequence_parallel,
-                name="qkv")(t)
+                init_method=_BERT_INIT, name="qkv")(t)
             qkv = (_sp_exit(qkv_t, B) if cfg.sequence_parallel
                    else qkv_t.reshape(B, -1, 3 * local_h))
         else:
@@ -159,10 +186,12 @@ class BertSelfAttention(nn.Module):
             cfg.fused_kernels and cfg.flash_attention
             and q.shape[2] >= cfg.flash_min_seq
             and (cfg.attention_dropout == 0.0 or deterministic)
-            # flash takes a per-key padding mask; the (B, 1, 1, Sk)
-            # convention from BertModel reduces to it exactly
+            # flash takes a BOOLEAN per-key padding mask; the (B, 1, 1, Sk)
+            # convention from BertModel reduces to it exactly. Additive
+            # float masks must go through the composed-softmax path.
             and (attention_mask is None
                  or (attention_mask.ndim == 4
+                     and attention_mask.dtype == jnp.bool_
                      and attention_mask.shape[1] == 1
                      and attention_mask.shape[2] == 1))
         )
@@ -176,7 +205,9 @@ class BertSelfAttention(nn.Module):
             scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
                                 preferred_element_type=jnp.float32) * inv_sqrt
             probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
-            probs = nn.Dropout(cfg.attention_dropout)(
+            # attention probs are head-sharded under TP: per-rank masks
+            probs = _TPDropout(cfg.attention_dropout,
+                               tp_varying=cfg.use_tensor_parallel)(
                 probs, deterministic=deterministic)
             ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
                              preferred_element_type=jnp.float32)
@@ -190,7 +221,7 @@ class BertSelfAttention(nn.Module):
             out_t = RowParallelLinear(
                 input_size=h, output_size=h, input_is_parallel=True,
                 sequence_parallel_enabled=cfg.sequence_parallel,
-                name="out")(t)
+                init_method=_BERT_INIT, name="out")(t)
             out = (_sp_exit(out_t, B) if cfg.sequence_parallel
                    else out_t.reshape(B, -1, h))
         else:
@@ -207,7 +238,11 @@ class BertLayer(nn.Module):
         B = x.shape[0]
         attn = BertSelfAttention(cfg, name="attention")(
             x, attention_mask, deterministic)
-        attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
+        # sequence-sharded under SP (per-rank tokens → per-rank masks);
+        # replicated under plain TP (masks must agree across ranks)
+        sp = cfg.use_tensor_parallel and cfg.sequence_parallel
+        attn = _TPDropout(cfg.hidden_dropout, tp_varying=sp)(
+            attn, deterministic=deterministic)
         x = _norm(cfg, "attention_ln")(x + attn)
 
         if cfg.use_tensor_parallel:
@@ -221,20 +256,21 @@ class BertLayer(nn.Module):
                 input_size=cfg.hidden_size, output_size=cfg.intermediate_size,
                 gather_output=False,
                 sequence_parallel_enabled=cfg.sequence_parallel,
-                name="mlp_in")(t)
+                init_method=_BERT_INIT, name="mlp_in")(t)
             hmid = nn.gelu(hmid)
             mlp_t = RowParallelLinear(
                 input_size=cfg.intermediate_size, output_size=cfg.hidden_size,
                 input_is_parallel=True,
                 sequence_parallel_enabled=cfg.sequence_parallel,
-                name="mlp_out")(hmid)
+                init_method=_BERT_INIT, name="mlp_out")(hmid)
             mlp = (_sp_exit(mlp_t, B) if cfg.sequence_parallel
                    else mlp_t.reshape(B, -1, cfg.hidden_size)).astype(cfg.dtype)
         else:
             hmid = _dense(cfg, cfg.intermediate_size, "mlp_in")(x)
             hmid = nn.gelu(hmid)
             mlp = _dense(cfg, cfg.hidden_size, "mlp_out")(hmid)
-        mlp = nn.Dropout(cfg.hidden_dropout)(mlp, deterministic=deterministic)
+        mlp = _TPDropout(cfg.hidden_dropout, tp_varying=sp)(
+            mlp, deterministic=deterministic)
         return _norm(cfg, "output_ln")(x + mlp)
 
 
@@ -332,7 +368,8 @@ class BertForPreTraining(nn.Module):
             # local-vocab-shard logits, consumed by vocab_parallel_cross_entropy
             mlm_logits = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=cfg.vocab_size,
-                gather_output=False, name="mlm_decoder",
+                gather_output=False, init_method=_BERT_INIT,
+                name="mlm_decoder",
             )(h.reshape(-1, cfg.hidden_size)).reshape(*h.shape[:-1], -1)
         else:
             mlm_logits = _dense(cfg, cfg.vocab_size, "mlm_decoder")(h)

@@ -4,6 +4,19 @@ from __future__ import annotations
 
 import jax
 
+LANE = 128  # TPU vector lane width (minor tile dim)
+
+
+def round_up(x: int, m: int) -> int:
+    """Round x up to a multiple of m (tile/lane alignment)."""
+    return (x + m - 1) // m * m
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run compiled on TPU, interpreted elsewhere (the
+    CPU-sim test path exercises identical kernel code)."""
+    return jax.default_backend() != "tpu"
+
 
 def use_jnp_fallback(*arrays) -> bool:
     """True when the Pallas interpreter cannot be used: non-TPU backend AND

@@ -46,18 +46,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._common import match_vma, out_struct, use_jnp_fallback
+from apex_tpu.ops._common import (
+    LANE,
+    interpret_mode as _interpret,
+    match_vma,
+    out_struct,
+    round_up as _round_up,
+    use_jnp_fallback,
+)
 
-LANE = 128
 FILL = -30000.0  # finite masked fill, matches ops/softmax.py
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 
@@ -94,8 +92,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     prec = _prec(q.dtype)
     s = _dot(q, k, ((1,), (1,)), prec) * scale     # (bq, bk)
 
-    masked = mask_ref[0, 0][None, :] != 0          # (1, bk) -> broadcast
-    s = jnp.where(masked, FILL, s)
+    # mask codes: 0 = live, 1 = user-masked (finite FILL — a fully-masked
+    # row degrades to uniform over the TRUE keys), 2 = wrapper padding
+    # (excluded from the distribution entirely, else an unaligned Sk
+    # inflates the denominator by Skp/Sk)
+    mrow = mask_ref[0, 0][None, :]                 # (1, bk) -> broadcast
+    s = jnp.where(mrow != 0, FILL, s)
     if causal:
         iq = pl.program_id(2)
         row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
@@ -105,6 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     m_prev = m_s[:, :1]                            # (bq, 1)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     p = jnp.exp(s - m_new)                         # (bq, bk)
+    p = jnp.where(mrow >= 2, 0.0, p)
     alpha = jnp.exp(m_prev - m_new)                # (bq, 1)
     l_new = alpha * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
@@ -139,8 +142,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0]
     prec = _prec(q.dtype)
     s = _dot(q, k, ((1,), (1,)), prec) * scale
-    masked = mask_ref[0, 0][None, :] != 0
-    s = jnp.where(masked, FILL, s)
+    mrow = mask_ref[0, 0][None, :]
+    s = jnp.where(mrow != 0, FILL, s)
     if causal:
         iq = pl.program_id(2)
         row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
@@ -149,6 +152,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
     lse = lse_ref[0, 0, 0][:, None]                # (bq, 1)
     p = jnp.exp(s - lse)                           # (bq, bk)
+    p = jnp.where(mrow >= 2, 0.0, p)               # padded keys: p exactly 0
     do = do_ref[0, 0]                              # (bq, D)
     v = v_ref[0, 0]                                # (bk, D)
     dp = _dot(do, v, ((1,), (1,)), prec)
@@ -175,8 +179,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0]                                # (bk, D)
     prec = _prec(q.dtype)
     s = _dot(q, k, ((1,), (1,)), prec) * scale
-    masked = mask_ref[0, 0][None, :] != 0
-    s = jnp.where(masked, FILL, s)
+    mrow = mask_ref[0, 0][None, :]
+    s = jnp.where(mrow != 0, FILL, s)
     if causal:
         ik = pl.program_id(2)
         row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
@@ -185,6 +189,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
     lse = lse_ref[0, 0, 0][:, None]
     p = jnp.exp(s - lse)                           # (bq, bk)
+    p = jnp.where(mrow >= 2, 0.0, p)               # padded keys: p exactly 0
     do = do_ref[0, 0]                              # (bq, D)
     # dv += p^T @ do
     dv_s[:] = dv_s[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)), prec)
@@ -314,18 +319,32 @@ def _pad_inputs(q, k, v, key_mask, bq, bk):
         q = jnp.pad(q, ((0, 0), (0, 0), (0, Sqp - Sq), (0, Dp - D)))
         k = jnp.pad(k, ((0, 0), (0, 0), (0, Skp - Sk), (0, Dp - D)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, Skp - Sk), (0, Dp - D)))
-        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, Skp - Sk)), constant_values=1)
+        # padding code 2: excluded from the softmax denominator in-kernel
+        # (code 1 = user-masked keys still count toward a fully-masked
+        # row's uniform fallback, matching the composed reference)
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, Skp - Sk)), constant_values=2)
     return q, k, v, mask
+
+
+def _block_dim(S):
+    """Largest lane-multiple block <= 512 that divides round_up(S, 128),
+    so padding never exceeds the 128-lane alignment (a fixed 512 block
+    would pad e.g. S=640 to 1024 — 2.5x wasted attention FLOPs)."""
+    MAXB = 512
+    Sp = _round_up(S, LANE)
+    for b in range(min(Sp, MAXB), 0, -LANE):
+        if Sp % b == 0:
+            return b
+    return LANE
 
 
 def _block_sizes(Sq, Sk):
     """Measured on v5e: large blocks win — at S=512, (512, 512) runs the
     whole attention row per grid step (the shape the reference fmha
     specializes for) and beats (128, 128) by 2.1x; VMEM stays bounded
-    (score tile 512*512*4B = 1 MB). Sequences longer than 512 tile at
-    (512, 512) with the online-softmax recurrence across key blocks."""
-    MAXB = 512
-    return (min(_round_up(Sq, LANE), MAXB), min(_round_up(Sk, LANE), MAXB))
+    (score tile 512*512*4B = 1 MB). Longer sequences tile with the
+    online-softmax recurrence across key blocks."""
+    return (_block_dim(Sq), _block_dim(Sk))
 
 
 def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0):

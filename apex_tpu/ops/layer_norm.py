@@ -37,17 +37,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import out_struct
-
-LANE = 128
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
+from apex_tpu.ops._common import (
+    LANE,
+    interpret_mode as _interpret,
+    out_struct,
+    round_up as _round_up,
+)
 
 
 def _block_rows(n_rows: int) -> int:

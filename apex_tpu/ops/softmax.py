@@ -26,18 +26,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import out_struct
+from apex_tpu.ops._common import (
+    LANE,
+    interpret_mode as _interpret,
+    out_struct,
+    round_up as _round_up,
+)
 
-LANE = 128
 _NEG = -30000.0  # large-negative fill, safe in bf16/fp32 (reference: -10000)
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _round_up(x, m):
-    return (x + m - 1) // m * m
 
 
 def _block_rows(n):
@@ -164,13 +160,30 @@ def scaled_softmax(x, scale: float = 1.0):
 def scaled_masked_softmax(x, mask, scale: float = 1.0):
     """softmax(scale * x + mask) for a padding mask (reference:
     ``scaled_masked_softmax_cuda``). ``mask`` is boolean (True = masked,
-    the reference convention) or additive float; broadcastable to x."""
+    the reference convention) or additive float; broadcastable to x.
+
+    The mask is pre-folded as mask/scale so the kernel's scale multiply
+    restores it exactly; that requires scale > 0 (a non-positive scale
+    would flip the fill sign and *un*-mask). The reference applies mask
+    after scale and so has no such constraint, but also no use for
+    scale <= 0 — reject it loudly rather than mis-mask silently."""
+    scale = float(scale)
     if mask is not None:
+        if scale <= 0.0:
+            raise ValueError(
+                f"scaled_masked_softmax requires scale > 0 when a mask "
+                f"is given (got {scale}): the mask is pre-divided by scale "
+                "so the in-kernel multiply restores it."
+            )
         if mask.dtype == jnp.bool_:
-            x = jnp.where(mask, jnp.asarray(_NEG / max(scale, 1e-6), x.dtype), x)
+            # _NEG/scale can exceed the input dtype's range for small
+            # scales (fp16 tops out at 65504); clamp to the dtype's finite
+            # min so fully-masked rows stay finite (uniform prob), not NaN
+            fill_val = max(_NEG / scale, float(jnp.finfo(x.dtype).min))
+            x = jnp.where(mask, jnp.asarray(fill_val, x.dtype), x)
         else:
-            x = x + (mask / max(scale, 1e-6)).astype(x.dtype)
-    return _fused_softmax(x, float(scale), False)
+            x = x + (mask / scale).astype(x.dtype)
+    return _fused_softmax(x, scale, False)
 
 
 def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):

@@ -32,10 +32,12 @@ class AttnMaskType(enum.Enum):
 
 def _apply_causal(x, scale):
     """Pre-fold the causal mask (as a large-negative fill surviving the
-    kernel's scale multiply) for the combined causal+padding-mask path."""
+    kernel's scale multiply) for the combined causal+padding-mask path.
+    Requires scale > 0, same as scaled_masked_softmax's pre-fold (the
+    downstream call validates and raises for scale <= 0)."""
     sq, sk = x.shape[-2], x.shape[-1]
     tril = jnp.tril(jnp.ones((sq, sk), bool))
-    fill = jnp.asarray(-30000.0 / max(abs(scale), 1e-6), x.dtype)
+    fill = jnp.asarray(-30000.0 / scale if scale > 0 else -30000.0, x.dtype)
     return jnp.where(tril, x, fill)
 
 

@@ -75,6 +75,15 @@ def spmd_pipeline(
       (num_microbatches, mb, ...) outputs as produced by the LAST stage
       (valid there; other stages hold garbage — reduce over the axis or
       read stage pp-1's shard).
+
+    Constraint (differs from the reference's shape-negotiating
+    ``_communicate``): the scan carry is fixed to the microbatch
+    shape/dtype, so ``stage_fn`` must be shape- and dtype-preserving.
+    Shape-changing stages (token ids → embeddings, hidden → logits) must
+    fold the change inside one stage (embed at the top of stage 0's fn,
+    project at the bottom of the last stage's, switched on
+    ``axis_index``). Violations raise immediately with the offending
+    shapes rather than an opaque scan carry-type error.
     """
     axis = axis_name or _axis()
     pp = parallel_state.get_pipeline_model_parallel_world_size()
@@ -125,15 +134,35 @@ def spmd_pipeline(
     except (AttributeError, TypeError):
         mb_vma = frozenset()
     vma = frozenset({axis}) | mb_vma  # injected microbatches carry their own
-    for _ in range(3):
+    converged = False
+    for it in range(4):  # the varying-set only grows and mesh axes are few
         def _probe(vma=vma):
             x = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), tuple(vma))
             return fn(stage_params, x, jnp.int32(0))
 
-        out_vma = frozenset(getattr(jax.eval_shape(_probe), "vma", ())) | vma
+        out_spec = jax.eval_shape(_probe)
+        if it == 0 and (out_spec.shape, out_spec.dtype) != (
+                mb_shape, microbatches.dtype):
+            raise ValueError(
+                "spmd_pipeline stage_fn must preserve the microbatch "
+                f"shape/dtype (the scan carry): got {out_spec.shape}/"
+                f"{out_spec.dtype} from input {mb_shape}/"
+                f"{microbatches.dtype}. Fold shape-changing ops (embedding "
+                "lookup, logit projection) inside the first/last stage's "
+                "fn, gated on axis_index."
+            )
+        out_vma = frozenset(getattr(out_spec, "vma", ())) | vma
         if out_vma == vma:
+            converged = True
             break
         vma = out_vma
+    if not converged:
+        raise RuntimeError(
+            "spmd_pipeline could not infer a stable varying-axes set for "
+            f"the scan carry (last iterate: {sorted(vma)}). The stage_fn's "
+            "output varying-set must reach a fixed point; check for "
+            "collectives over axes not in the current mesh."
+        )
     mark = tuple(vma)
 
     init_state = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), mark)

@@ -12,9 +12,13 @@ SURVEY.md §2.2).
 
 Weight partitioning matches the reference: ColumnParallelLinear splits the
 output dim, RowParallelLinear the input dim, VocabParallelEmbedding the
-vocab rows. Per-rank initialization derives from a shared key +
-``fold_in(tp_rank)`` so the full weight matrix is reproducible (the
-reference's ``_initialize_affine_weight`` master-weight scheme).
+vocab rows. Initialization follows the reference's
+``_initialize_affine_weight`` master-weight scheme exactly: every rank
+materializes the FULL weight from the SHARED key and dynamic-slices its
+own shard, so fan-in/fan-out-scaled initializers (lecun/xavier) see the
+full-matrix shape and the assembled weight is independent of tp. (A
+per-shard init would inflate row-parallel stddev by sqrt(tp).) The full
+matrix exists only transiently at init; XLA DCEs the unused slices.
 """
 
 from __future__ import annotations
@@ -35,12 +39,26 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     scatter_to_tensor_model_parallel_region,
 )
 
-from apex_tpu.transformer.tensor_parallel.random import model_parallel_key
-
 default_init = nn.initializers.lecun_normal()
 
-# per-TP-rank init key (reference: per-rank RNG tracker seeds)
-_rank_key = model_parallel_key
+
+def _master_init(init_method, key, full_shape, dtype, axis, num_shards, shard_size):
+    """Reference ``_initialize_affine_weight``: init the full master weight
+    from the shared key, then slice this rank's shard along ``axis``.
+
+    Run per-rank inside ``shard_map``; the key is NOT rank-folded, so all
+    ranks compute the identical master matrix and take disjoint slices —
+    the assembled weight (and its variance) matches the single-device
+    init bit-for-bit regardless of tp."""
+    full = init_method(key, full_shape, dtype)
+    if num_shards == 1:
+        return full
+    rank = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+    starts = [0] * len(full_shape)
+    sizes = list(full_shape)
+    starts[axis] = rank * shard_size
+    sizes[axis] = shard_size
+    return jax.lax.dynamic_slice(full, starts, sizes)
 
 
 class ColumnParallelLinear(nn.Module):
@@ -73,7 +91,9 @@ class ColumnParallelLinear(nn.Module):
         local_out = self.output_size // tp
         kernel = self.param(
             "kernel",
-            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            lambda k, s, d: _master_init(
+                self.init_method, k, (self.input_size, self.output_size),
+                d, 1, tp, local_out),
             (self.input_size, local_out),
             self.params_dtype,
         )
@@ -133,7 +153,9 @@ class RowParallelLinear(nn.Module):
         local_in = self.input_size // tp
         kernel = self.param(
             "kernel",
-            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            lambda k, s, d: _master_init(
+                self.init_method, k, (self.input_size, self.output_size),
+                d, 0, tp, local_in),
             (local_in, self.output_size),
             self.params_dtype,
         )
@@ -186,7 +208,9 @@ class VocabParallelEmbedding(nn.Module):
         per = self.num_embeddings // tp
         table = self.param(
             "embedding",
-            lambda k, s, d: self.init_method(_rank_key(k), s, d),
+            lambda k, s, d: _master_init(
+                self.init_method, k, (self.num_embeddings, self.embedding_dim),
+                d, 0, tp, per),
             (per, self.embedding_dim),
             self.params_dtype,
         )

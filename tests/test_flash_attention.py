@@ -53,11 +53,15 @@ def test_parity_fwd_bwd(shape, causal, use_mask):
         assert _max_err(a, b) < 3e-4
 
 
-def test_fully_masked_rows_are_finite():
+@pytest.mark.parametrize("S", [128, 100, 37])
+def test_fully_masked_rows_are_finite(S):
     """All keys masked -> uniform distribution (finite), matching the
-    reference's -30000 fill semantics, not NaN."""
-    q, k, v = _mk(1, 1, 128, 128, 64)
-    km = jnp.ones((1, 128), bool)
+    reference's -30000 fill semantics, not NaN. Unaligned S regression:
+    wrapper-padded keys must NOT count toward the uniform denominator
+    (an Sk=100 row block pads to 128; the old code returned outputs
+    scaled by 100/128)."""
+    q, k, v = _mk(1, 1, S, S, 64)
+    km = jnp.ones((1, S), bool)
     out = flash_attention(q, k, v, km, False, 0.125)
     assert bool(jnp.all(jnp.isfinite(out)))
     ref = mha_reference(q, k, v, km, False, 0.125)

@@ -275,6 +275,53 @@ def test_vocab_parallel_cross_entropy_label_smoothing():
     np.testing.assert_allclose(float(loss_tp), float(ref), rtol=1e-5)
 
 
+def test_master_weight_init_parity():
+    """The assembled tp>1 weight must equal the single-device init from
+    the same key (the reference's _initialize_affine_weight contract) —
+    so fan-in-scaled initializers keep the correct stddev at any tp."""
+    col = ColumnParallelLinear(input_size=8, output_size=16, gather_output=False,
+                               bias=False)
+    row = RowParallelLinear(input_size=16, output_size=8, input_is_parallel=True,
+                            bias=False)
+    emb = VocabParallelEmbedding(num_embeddings=16, embedding_dim=8)
+    x8 = jnp.zeros((4, 8))
+    x4 = jnp.zeros((4, 4))
+    ids = jnp.zeros((2, 3), jnp.int32)
+
+    def f(_):
+        wc = jax.lax.all_gather(
+            col.init(jax.random.PRNGKey(1), x8)["params"]["kernel"],
+            "tensor", axis=1, tiled=True)
+        wr = jax.lax.all_gather(
+            row.init(jax.random.PRNGKey(2), x4)["params"]["kernel"],
+            "tensor", axis=0, tiled=True)
+        we = jax.lax.all_gather(
+            emb.init(jax.random.PRNGKey(3), ids)["params"]["embedding"],
+            "tensor", axis=0, tiled=True)
+        return (jax.lax.pmean(wc, "tensor"), jax.lax.pmean(wr, "tensor"),
+                jax.lax.pmean(we, "tensor"))
+
+    wc, wr, we = _tp_map(f, jnp.zeros(()), out_specs=(P(), P(), P()))
+
+    # reference: the SAME modules initialized at tp=1 (full weights)
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=1)
+
+    def ref(_):
+        return (col.init(jax.random.PRNGKey(1), x8)["params"]["kernel"],
+                row.init(jax.random.PRNGKey(2), jnp.zeros((4, 16)))["params"]["kernel"],
+                emb.init(jax.random.PRNGKey(3), ids)["params"]["embedding"])
+
+    mesh1 = parallel_state.get_mesh()
+    wc_ref, wr_ref, we_ref = jax.jit(jax.shard_map(
+        ref, mesh=mesh1, in_specs=P(), out_specs=(P(), P(), P())))(jnp.zeros(()))
+    np.testing.assert_allclose(np.asarray(wc), np.asarray(wc_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(wr), np.asarray(wr_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(we), np.asarray(we_ref), rtol=1e-6)
+    # row-parallel stddev must reflect the FULL fan_in (16), not 16/tp
+    assert abs(float(jnp.std(wr)) - (1.0 / 16) ** 0.5) < 0.05
+
+
 def test_rng_tracker_streams():
     from apex_tpu.transformer.tensor_parallel import (
         get_rng_state_tracker,
