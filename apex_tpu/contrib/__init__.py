@@ -1,0 +1,4 @@
+"""Contrib tier (reference: ``apex/contrib/``): semi-supported
+subpackages, each mirroring an upstream contrib component on TPU-native
+machinery. Import subpackages explicitly (``apex_tpu.contrib.optimizers``
+etc.), matching the reference's opt-in import style."""

@@ -1,0 +1,13 @@
+"""Contrib optimizers (reference: ``apex/contrib/optimizers/``)."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    ShardedOptState,
+)
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "ShardedOptState",
+]

@@ -18,7 +18,13 @@ materializes the FULL weight from the SHARED key and dynamic-slices its
 own shard, so fan-in/fan-out-scaled initializers (lecun/xavier) see the
 full-matrix shape and the assembled weight is independent of tp. (A
 per-shard init would inflate row-parallel stddev by sqrt(tp).) The full
-matrix exists only transiently at init; XLA DCEs the unused slices.
+matrix exists only transiently at init but IS materialized per rank
+(the slice start is the traced rank index, so XLA cannot elide the
+generation); for weights too large to materialize (huge vocab x hidden),
+set ``master_weight_init=False`` to use a rank-folded per-shard init —
+distributionally identical for scale-free initializers like
+``normal(stddev)``, but NOT variance-correct for fan-scaled ones on
+row-parallel layers.
 """
 
 from __future__ import annotations
@@ -42,18 +48,26 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
 default_init = nn.initializers.lecun_normal()
 
 
-def _master_init(init_method, key, full_shape, dtype, axis, num_shards, shard_size):
+def _master_init(init_method, key, full_shape, dtype, axis, num_shards,
+                 shard_size, enabled: bool = True):
     """Reference ``_initialize_affine_weight``: init the full master weight
     from the shared key, then slice this rank's shard along ``axis``.
 
     Run per-rank inside ``shard_map``; the key is NOT rank-folded, so all
     ranks compute the identical master matrix and take disjoint slices —
     the assembled weight (and its variance) matches the single-device
-    init bit-for-bit regardless of tp."""
-    full = init_method(key, full_shape, dtype)
+    init bit-for-bit regardless of tp. With ``enabled=False`` (weights
+    too large to materialize per rank) falls back to a rank-folded
+    per-shard init."""
     if num_shards == 1:
-        return full
+        return init_method(key, full_shape, dtype)
     rank = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+    if not enabled:
+        shard_shape = list(full_shape)
+        shard_shape[axis] = shard_size
+        return init_method(jax.random.fold_in(key, rank),
+                           tuple(shard_shape), dtype)
+    full = init_method(key, full_shape, dtype)
     starts = [0] * len(full_shape)
     sizes = list(full_shape)
     starts[axis] = rank * shard_size
@@ -78,6 +92,7 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     gradient_accumulation_fusion: bool = False  # parity; XLA fuses wgrad
     init_method: Callable = default_init
+    master_weight_init: bool = True
     params_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -93,7 +108,7 @@ class ColumnParallelLinear(nn.Module):
             "kernel",
             lambda k, s, d: _master_init(
                 self.init_method, k, (self.input_size, self.output_size),
-                d, 1, tp, local_out),
+                d, 1, tp, local_out, self.master_weight_init),
             (self.input_size, local_out),
             self.params_dtype,
         )
@@ -140,6 +155,7 @@ class RowParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     gradient_accumulation_fusion: bool = False
     init_method: Callable = default_init
+    master_weight_init: bool = True
     params_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -155,7 +171,7 @@ class RowParallelLinear(nn.Module):
             "kernel",
             lambda k, s, d: _master_init(
                 self.init_method, k, (self.input_size, self.output_size),
-                d, 0, tp, local_in),
+                d, 0, tp, local_in, self.master_weight_init),
             (local_in, self.output_size),
             self.params_dtype,
         )
@@ -194,6 +210,7 @@ class VocabParallelEmbedding(nn.Module):
     num_embeddings: int
     embedding_dim: int
     init_method: Callable = nn.initializers.normal(stddev=0.02)
+    master_weight_init: bool = True
     params_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -210,7 +227,7 @@ class VocabParallelEmbedding(nn.Module):
             "embedding",
             lambda k, s, d: _master_init(
                 self.init_method, k, (self.num_embeddings, self.embedding_dim),
-                d, 0, tp, per),
+                d, 0, tp, per, self.master_weight_init),
             (per, self.embedding_dim),
             self.params_dtype,
         )

@@ -1,0 +1,190 @@
+"""ZeRO sharded-DP optimizer tests (upstream analog: the contrib
+``distributed_fused_adam``/``distributed_fused_lamb`` tests — shrunk
+world size, real collectives; SURVEY.md §2.3) on the 8-device CPU mesh.
+
+Core properties, per VERDICT round-1 item 5:
+- trajectories match the UNSHARDED FusedAdam/FusedLAMB at dp=8 to fp32
+  roundoff (same math, different storage layout);
+- per-device optimizer state is N/dp, not N (the ZeRO memory claim);
+- skip_if (amp overflow) leaves params, moments, and step untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+DP = 8
+
+
+def _mesh():
+    return jax.make_mesh((DP,), ("data",))
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w1": jnp.asarray(rng.randn(5, 7).astype("float32")),
+        "b1": jnp.asarray(rng.randn(7).astype("float32")),
+        "inner": {"w2": jnp.asarray(rng.randn(7, 3).astype("float32"))},
+    }
+
+
+def _per_device_grads():
+    """8 distinct grad pytrees stacked on a leading device axis."""
+    trees = [_params(seed=10 + i) for i in range(DP)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _mean_grads():
+    trees = [_params(seed=10 + i) for i in range(DP)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs).mean(0), *trees)
+
+
+def _run_sharded(opt, params, stacked_grads, steps=3, skip_if=None):
+    mesh = _mesh()
+
+    def f(params, grads_stack):
+        grads = jax.tree.map(lambda g: g[0], grads_stack)  # this rank's
+        state = opt.init(params)
+        p = params
+        for _ in range(steps):
+            p, state = opt.step(grads, state, p, skip_if=skip_if)
+        state = state._replace(step=state.step[None])  # rank-0 concat-able
+        # stack per-rank copies rather than pmean (the CPU backend's
+        # all-reduce is a ulp off even on identical replicas)
+        return jax.tree.map(lambda x: x[None], p), state
+
+    p_stack, state = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P("data")),
+        out_specs=(P("data"), P("data")),
+    ))(params, stacked_grads)
+    # all ranks must agree exactly after the all_gather
+    p_host = jax.tree.map(lambda x: np.asarray(x), p_stack)
+    for leaf in jax.tree.leaves(p_host):
+        np.testing.assert_array_equal(
+            leaf, np.broadcast_to(leaf[0], leaf.shape))
+    return jax.tree.map(lambda x: jnp.asarray(x[0]), p_host), state
+
+
+@pytest.mark.parametrize("dist_opt,ref_opt", [
+    (DistributedFusedAdam(lr=1e-2, weight_decay=0.01, group_size=DP),
+     FusedAdam(lr=1e-2, weight_decay=0.01)),
+    (DistributedFusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=False,
+                          group_size=DP),
+     FusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=False)),
+    (DistributedFusedLAMB(lr=1e-2, weight_decay=0.01, group_size=DP),
+     FusedLAMB(lr=1e-2, weight_decay=0.01)),
+    (DistributedFusedLAMB(lr=1e-2, weight_decay=0.0, use_nvlamb=True,
+                          group_size=DP),
+     FusedLAMB(lr=1e-2, weight_decay=0.0, use_nvlamb=True)),
+])
+def test_trajectory_matches_unsharded(dist_opt, ref_opt):
+    """dp=8 sharded trajectory == unsharded optimizer fed the mean grad."""
+    params = _params()
+    p_sharded, _ = _run_sharded(dist_opt, params, _per_device_grads())
+
+    mean_g = _mean_grads()
+    state = ref_opt.init(params)
+    p_ref = params
+    for _ in range(3):
+        p_ref, state = ref_opt.step(mean_g, state, p_ref)
+
+    for k, a in jax.tree.leaves_with_path(p_sharded):
+        b = dict(jax.tree.leaves_with_path(p_ref))[k]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_state_is_sharded_n_over_dp():
+    """The ZeRO claim: per-device moment/master vectors hold N/dp
+    elements (padded), not N."""
+    params = _params()
+    n_total = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    shard = -(-n_total // DP)
+    opt = DistributedFusedAdam(group_size=DP)
+    mesh = _mesh()
+
+    state = jax.jit(jax.shard_map(
+        lambda p: opt.init(p)._replace(step=opt.init(p).step[None]),
+        mesh=mesh, in_specs=P(), out_specs=P("data")))(params)
+    # per-rank shards concatenate along axis 0: (DP * shard,) total —
+    # i.e. each device holds only (shard,) = N/dp elements
+    assert state.exp_avg.shape == (DP * shard,)
+    assert state.master.shape == (DP * shard,)
+    assert DP * shard < 2 * n_total  # genuinely sharded, not replicated
+
+
+def test_skip_if_freezes_everything():
+    params = _params()
+    opt = DistributedFusedAdam(lr=1e-2, group_size=DP)
+    p1, s1 = _run_sharded(opt, params, _per_device_grads(), steps=2,
+                          skip_if=jnp.bool_(True))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(s1.step).ravel()[0]) == 0
+
+
+def test_bf16_params_gather_in_model_dtype():
+    """Uniform-bf16 models all-gather in bf16 (half the bytes); the
+    trajectory still matches the unsharded optimizer stepping bf16 params
+    with fp32 masters."""
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), _params())
+    opt = DistributedFusedAdam(lr=1e-2, group_size=DP)
+    assert opt._meta(params).gather_dtype == jnp.bfloat16
+    grads = jax.tree.map(lambda p: jnp.stack([p] * DP), params)
+    p1, _ = _run_sharded(opt, params, grads, steps=3)
+
+    ref = FusedAdam(lr=1e-2, master_weights=True)
+    state = ref.init(params)
+    p_ref = params
+    for _ in range(3):
+        p_ref, state = ref.step(params, state, p_ref)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p_ref)):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-2, atol=1e-3)
+
+
+def test_predivide_vs_grad_averaging_knobs():
+    """predivide_grads (DDP mean) and LAMB's grad_averaging (beta3) are
+    independent: turning off grad_averaging must NOT drop the dp mean."""
+    opt = DistributedFusedLAMB(lr=1e-2, grad_averaging=False, group_size=DP)
+    assert opt.predivide_grads is True
+    params = _params()
+    p1, _ = _run_sharded(opt, params, _per_device_grads(), steps=2)
+
+    ref = FusedLAMB(lr=1e-2, grad_averaging=False)
+    state = ref.init(params)
+    p_ref = params
+    for _ in range(2):
+        p_ref, state = ref.step(_mean_grads(), state, p_ref)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_unaligned_total_padding():
+    """Total param count not divisible by dp: padded tail must stay inert
+    and reconstructed params must match exactly."""
+    params = {"w": jnp.asarray(np.random.RandomState(0)
+                               .randn(3, 5).astype("float32"))}  # 15 % 8 != 0
+    opt = DistributedFusedAdam(lr=1e-2, group_size=DP)
+    p1, _ = _run_sharded(opt, params, jax.tree.map(
+        lambda p: jnp.stack([p] * DP), params), steps=2)
+
+    ref = FusedAdam(lr=1e-2)
+    state = ref.init(params)
+    p_ref = params
+    for _ in range(2):
+        p_ref, state = ref.step(params, state, p_ref)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p_ref["w"]),
+                               rtol=2e-5, atol=2e-6)
