@@ -34,3 +34,6 @@ from apex_tpu import multi_tensor_apply  # noqa: F401
 from apex_tpu import optimizers  # noqa: F401
 from apex_tpu import normalization  # noqa: F401
 from apex_tpu import parallel  # noqa: F401
+from apex_tpu import fp16_utils  # noqa: F401
+from apex_tpu import mlp  # noqa: F401
+from apex_tpu import fused_dense  # noqa: F401

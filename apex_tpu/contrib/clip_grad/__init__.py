@@ -1,0 +1,8 @@
+"""Contrib clip_grad (reference: ``apex/contrib/clip_grad``)."""
+
+from apex_tpu.contrib.clip_grad.clip_grad import (
+    clip_grad_norm,
+    clip_grad_norm_,
+)
+
+__all__ = ["clip_grad_norm", "clip_grad_norm_"]

@@ -1,0 +1,58 @@
+"""Gradient clipping on the fused L2-norm pass (reference:
+``apex/contrib/clip_grad/clip_grad.py``, SURVEY.md §2.5).
+
+The reference's ``clip_grad_norm_`` replaces torch's per-tensor norm loop
+with one ``multi_tensor_l2norm`` launch + one ``multi_tensor_scale``.
+Functional form here (grads are values, not ``.grad`` slots): returns
+``(clipped_grads, total_norm)`` and reuses the same fused ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import multi_tensor_l2norm, multi_tensor_scale
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Clip a grad pytree to ``max_norm`` total norm.
+
+    Matches ``torch.nn.utils.clip_grad_norm_`` semantics (the reference
+    delegates to them): ``total_norm`` is the norm of the per-tensor
+    norms; grads scale by ``max_norm / (total_norm + 1e-6)`` only when
+    that coefficient is < 1. Returns ``(clipped_grads, total_norm)``.
+
+    ``norm_type=2`` uses the fused ``multi_tensor_l2norm`` pass; other
+    norms (incl. ``inf``) use a jnp reduction.
+    """
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return grads, jnp.float32(0.0)
+    if norm_type == 2.0:
+        total_norm, _ = multi_tensor_applier(
+            multi_tensor_l2norm, None, [leaves], False)
+    elif norm_type == float("inf"):
+        total_norm = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves]))
+    else:
+        total_norm = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in leaves])) ** (1.0 / norm_type)
+
+    # torch's error_if_nonfinite raises on the host; in-graph the norm is
+    # a traced value, so the contract becomes: non-finite norms propagate
+    # NaN into the clipped grads (scale below is NaN), and callers check
+    # the returned total_norm — the amp scaler's skip_if path does.
+    clip_coef = max_norm / (total_norm + 1e-6)
+    scale = jnp.minimum(clip_coef, 1.0)
+    clipped_leaves, _ = multi_tensor_applier(
+        multi_tensor_scale, None, [leaves, leaves], scale)
+    clipped = jax.tree.unflatten(jax.tree.structure(grads), clipped_leaves)
+    return clipped, total_norm
+
+
+# reference alias (same function; grads are functional values here)
+clip_grad_norm = clip_grad_norm_

@@ -14,15 +14,21 @@ stream inside ``shard_map`` over the data-parallel mesh axis —
    the SUMMED gradient slice for its shard — the reduce-scatter the
    reference issues per bucket, here one XLA collective that rides ICI.
    ``predivide_grads`` (default) divides by dp for the DDP gradient mean.
-2. the Adam/LAMB math on the rank's ``N/dp`` fp32 shard, DELEGATED to the
-   same ``ops.multi_tensor`` update functions the unsharded optimizers
-   use (single-leaf lists over the flat shard), so sharded and unsharded
-   trajectories agree by construction. LAMB's per-tensor trust ratios are
-   the one exception: tensors span shard boundaries, so each rank
-   segment-sums its shard's squared entries into per-tensor partials
-   (static segment map) and one ``psum`` completes the exact norms — the
-   analog of the reference's partial-norm + allreduce in
-   ``distributed_fused_lamb._pipeline_block_reductions``.
+2. the Adam/LAMB math on the rank's shard, DELEGATED to the same
+   ``ops.multi_tensor`` update functions the unsharded optimizers use,
+   so sharded and unsharded trajectories agree by construction. The
+   shard is held as a LANE-shaped ``(shard/128, 128)`` 2-D buffer, not
+   1-D: elementwise update streams over a huge 1-D vector invite XLA's
+   horizontal [N,2] packing whose ``T(8,128)`` tiled layout pads the
+   size-2 minor dim 64x (the 94 GB pathology documented in
+   ``ops/multi_tensor.py``); a lane-major 2-D shape tiles natively.
+   LAMB's per-tensor trust ratios are computed across shard boundaries:
+   each rank segment-sums its shard's squared entries into per-tensor
+   partials and one ``psum`` completes the exact norms — the analog of
+   the reference's partial-norm + allreduce in
+   ``distributed_fused_lamb._pipeline_block_reductions``. Segment ids
+   come from a ``searchsorted`` over the static leaf-offset table, O(N/dp)
+   per device (never a full-length N map).
 3. ``all_gather`` (tiled) of the updated shard back to the full flat
    vector. When every parameter shares one low-precision dtype (the O2
    bf16 case) the shard is cast BEFORE the gather, halving the dominant
@@ -48,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.ops._common import LANE, round_up
 from apex_tpu.ops.multi_tensor import (
     ADAM_MODE_ADAMW,
     ADAM_MODE_L2,
@@ -55,23 +62,30 @@ from apex_tpu.ops.multi_tensor import (
     multi_tensor_lamb_stage1,
 )
 from apex_tpu.optimizers._base import FusedOptimizer
-from apex_tpu.utils.pytree import tree_select
+from apex_tpu.utils.pytree import ravel_list, tree_select, unravel_list
 
 
 class _FlatMeta:
-    """Static flattening metadata for a params pytree (trace-time only)."""
+    """Static flattening metadata for a params pytree (trace-time only).
+
+    The padded length is a multiple of ``world * LANE`` so every rank's
+    shard reshapes exactly to ``(rows, LANE)`` (see module docstring on
+    why the shard must be lane-shaped)."""
 
     def __init__(self, params, world_size: int):
         leaves = jax.tree.leaves(params)
         self.treedef = jax.tree.structure(params)
-        self.shapes = [l.shape for l in leaves]
-        self.dtypes = [l.dtype for l in leaves]
-        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.meta = [(l.shape, l.dtype, l.size) for l in leaves]
+        self.sizes = [m[2] for m in self.meta]
+        self.dtypes = [m[1] for m in self.meta]
         self.total = sum(self.sizes)
         self.world = world_size
-        self.padded = -(-self.total // world_size) * world_size
+        self.padded = round_up(max(self.total, 1), world_size * LANE)
         self.shard = self.padded // world_size
+        self.rows = self.shard // LANE
         self.num_leaves = len(leaves)
+        # static cumulative end-offsets for per-tensor segment lookup
+        self.offsets = np.cumsum(self.sizes).astype(np.int32)
         # gather in model dtype when it is a single low-precision dtype
         # (halves the all_gather); otherwise keep the fp32 master stream
         uniq = set(self.dtypes)
@@ -80,41 +94,38 @@ class _FlatMeta:
         else:
             self.gather_dtype = jnp.float32
 
-    def flatten(self, tree, dtype=jnp.float32):
-        flat = jnp.concatenate(
-            [l.reshape(-1).astype(dtype) for l in jax.tree.leaves(tree)])
+    def flatten(self, tree):
+        """apex_C.flatten analog (fp32 stream) + ZeRO padding."""
+        flat, _ = ravel_list(
+            [l.astype(jnp.float32) for l in jax.tree.leaves(tree)])
         if self.padded != self.total:
             flat = jnp.pad(flat, (0, self.padded - self.total))
         return flat
 
     def unflatten(self, flat):
-        out, off = [], 0
-        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
-            out.append(flat[off:off + size].reshape(shape).astype(dtype))
-            off += size
-        return jax.tree.unflatten(self.treedef, out)
+        leaves = unravel_list(flat[:self.total], self.meta)
+        return jax.tree.unflatten(self.treedef, leaves)
 
-    def segment_ids(self):
-        """(padded,) int32 mapping each flat element to its leaf index;
-        padding tail maps to the dummy bucket ``num_leaves``."""
-        ids = np.repeat(np.arange(self.num_leaves, dtype=np.int32),
-                        self.sizes)
-        if self.padded != self.total:
-            ids = np.concatenate([
-                ids,
-                np.full(self.padded - self.total, self.num_leaves, np.int32),
-            ])
-        return jnp.asarray(ids)
+    def shard_segment_ids(self, rank):
+        """(rows, LANE) int32 leaf index per shard element, computed
+        arithmetically from the static offset table (O(shard), not O(N));
+        the padding tail maps to the dummy bucket ``num_leaves``."""
+        pos = rank * self.shard + jnp.arange(self.shard, dtype=jnp.int32)
+        seg = jnp.searchsorted(jnp.asarray(self.offsets), pos, side="right")
+        return seg.reshape(self.rows, LANE)
 
     def shard_slice(self, flat, rank):
-        return jax.lax.dynamic_slice(flat, (rank * self.shard,), (self.shard,))
+        """This rank's lane-shaped shard of a (padded,) stream."""
+        return jax.lax.dynamic_slice(
+            flat, (rank * self.shard,), (self.shard,)
+        ).reshape(self.rows, LANE)
 
 
 class ShardedOptState(NamedTuple):
     step: jnp.ndarray
-    exp_avg: jnp.ndarray      # (N/dp,) fp32 shard
-    exp_avg_sq: jnp.ndarray   # (N/dp,) fp32 shard
-    master: jnp.ndarray       # (N/dp,) fp32 master-param shard
+    exp_avg: jnp.ndarray      # (shard/128, 128) fp32
+    exp_avg_sq: jnp.ndarray   # (shard/128, 128) fp32
+    master: jnp.ndarray       # (shard/128, 128) fp32 master params
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,7 +152,7 @@ class _DistributedFlatOptimizer(FusedOptimizer):
         meta = self._meta(params)
         rank = jax.lax.axis_index(self.process_group)
         master = meta.shard_slice(meta.flatten(params), rank)
-        zeros = jnp.zeros((meta.shard,), jnp.float32)
+        zeros = jnp.zeros((meta.rows, LANE), jnp.float32)
         return ShardedOptState(
             step=jnp.zeros((), jnp.int32),
             exp_avg=zeros,
@@ -155,13 +166,13 @@ class _DistributedFlatOptimizer(FusedOptimizer):
             flat_g, self.process_group, scatter_dimension=0, tiled=True)
         if self.predivide_grads:
             gshard = gshard / meta.world
-        return gshard
+        return gshard.reshape(meta.rows, LANE)
 
     def _gather_params(self, new_master, meta, params):
         full = jax.lax.all_gather(
-            new_master.astype(meta.gather_dtype), self.process_group,
-            axis=0, tiled=True)
-        return meta.unflatten(full[:meta.total])
+            new_master.reshape(-1).astype(meta.gather_dtype),
+            self.process_group, axis=0, tiled=True)
+        return meta.unflatten(full)
 
     def _finish(self, skip_if, new_params, new_state, params, state):
         if skip_if is None:
@@ -176,8 +187,8 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
     Adam/AdamW with ZeRO-sharded fp32 state over the data axis.
 
     The shard update IS ``multi_tensor_adam`` (the unsharded FusedAdam's
-    math) applied to the flat shard, so trajectories agree with the
-    unsharded optimizer to fp32 roundoff."""
+    math) applied to the lane-shaped shard, so trajectories agree with
+    the unsharded optimizer to fp32 roundoff."""
 
     lr: float = 1e-3
     bias_correction: bool = True
@@ -213,10 +224,11 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
     two-stage LAMB with ZeRO-sharded fp32 state.
 
     Stage 1 (clip + moments + update direction) delegates to
-    ``multi_tensor_lamb_stage1`` on the flat shard with the psum-completed
-    global grad norm. Stage 2 cannot delegate: per-tensor trust ratios
-    need per-tensor norms across shard boundaries — computed via the
-    static segment map + one psum (see module docstring).
+    ``multi_tensor_lamb_stage1`` on the lane-shaped shard with the
+    psum-completed global grad norm. Stage 2 cannot delegate: per-tensor
+    trust ratios need per-tensor norms across shard boundaries —
+    computed via the arithmetic segment map + one psum (see module
+    docstring).
 
     ``grad_averaging`` matches FusedLAMB (folds beta3 only); the DDP mean
     division is the separate ``predivide_grads`` knob."""
@@ -242,9 +254,8 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
         lr = self.lr if lr is None else lr
         meta = self._meta(params)
         step = state.step + 1
-        seg_full = meta.segment_ids()
         rank = jax.lax.axis_index(self.process_group)
-        seg = meta.shard_slice(seg_full, rank)
+        seg = meta.shard_segment_ids(rank)
         nbuckets = meta.num_leaves + 1  # + dummy padding bucket
 
         g = self._reduce_scatter_grads(grads, meta)

@@ -1,0 +1,8 @@
+"""Contrib xentropy (reference: ``apex/contrib/xentropy``)."""
+
+from apex_tpu.contrib.xentropy.softmax_xentropy import (
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]

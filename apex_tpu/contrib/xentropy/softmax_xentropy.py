@@ -1,0 +1,67 @@
+"""Fused softmax cross-entropy with label smoothing (reference:
+``apex/contrib/xentropy/softmax_xentropy.py`` + ``apex/contrib/csrc/
+xentropy/``, SURVEY.md §2.5).
+
+The reference's CUDA kernel fuses max/logsumexp/gather into one pass to
+avoid materializing log-probabilities. Here the fused form is the
+logsumexp identity itself —
+
+    loss = logsumexp(logits) - (1-eps) * logits[target]
+           - eps * mean(logits)
+
+— which XLA compiles to one reduction pass over the logits; the backward
+(softmax(logits) minus the smoothed one-hot) comes from autodiff of the
+same expression, again without a log-prob tensor.
+
+API parity: ``SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing,
+padding_idx, half_to_float)`` returning PER-EXAMPLE losses (the
+reference returns unreduced losses; callers ``.sum()``/``.mean()``), and
+zero loss at ``padding_idx`` labels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0,
+                               padding_idx: int = 0,
+                               half_to_float: bool = False):
+    """Per-example smoothed cross-entropy; fp32 math internally.
+
+    Args:
+      logits: (..., vocab).
+      labels: (...) int targets.
+      smoothing: label-smoothing epsilon in [0, 1).
+      padding_idx: labels equal to this yield exactly 0 loss (the
+        reference's convention; use a negative sentinel to disable).
+      half_to_float: return fp32 losses from fp16/bf16 logits (the
+        reference knob; fp32 is returned either way here since the loss
+        math is fp32 — kept for call-site parity).
+    """
+    x = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(x, axis=-1)
+    safe_labels = jnp.where(labels == padding_idx, 0, labels)
+    picked = jnp.take_along_axis(x, safe_labels[..., None], axis=-1)[..., 0]
+    if smoothing == 0.0:
+        loss = lse - picked
+    else:
+        mean_x = jnp.mean(x, axis=-1)
+        loss = lse - (1.0 - smoothing) * picked - smoothing * mean_x
+    loss = jnp.where(labels == padding_idx, 0.0, loss)
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
+    return loss
+
+
+class SoftmaxCrossEntropyLoss:
+    """Reference class shape: ``SoftmaxCrossEntropyLoss.apply(...)``
+    (a torch.autograd.Function there; here the fused expression is
+    differentiable by construction)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing: float = 0.0, padding_idx: int = 0,
+              half_to_float: bool = False):
+        return softmax_cross_entropy_loss(
+            logits, labels, smoothing, padding_idx, half_to_float)

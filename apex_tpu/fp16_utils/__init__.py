@@ -1,0 +1,23 @@
+"""apex.fp16_utils parity surface (reference: ``apex/fp16_utils``)."""
+
+from apex_tpu.amp.scaler import DynamicLossScaler, LossScaler
+from apex_tpu.fp16_utils.fp16_optimizer import FP16OptState, FP16_Optimizer
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+
+__all__ = [
+    "DynamicLossScaler",
+    "FP16OptState",
+    "FP16_Optimizer",
+    "LossScaler",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "to_python_float",
+]

@@ -1,0 +1,118 @@
+"""Legacy ``FP16_Optimizer`` (reference:
+``apex/fp16_utils/fp16_optimizer.py``, SURVEY.md §2.1).
+
+The reference wraps any torch optimizer: it keeps fp32 master params,
+scales the loss (static or ``DynamicLossScaler``), copies model grads to
+master fp32 grads, unscales, skips the step on overflow, and copies
+updated masters back into the fp16 model. That is exactly the amp-O2
+data flow, so this class is a thin veneer over the same pieces the amp
+path uses: ``LossScaler`` (identical constants) + a wrapped
+``apex_tpu.optimizers`` fused optimizer with ``master_weights``.
+
+Functional contract (the torch version mutates ``.grad``/``.data``)::
+
+    opt = FP16_Optimizer(FusedSGD(lr=1e-2), dynamic_loss_scale=True)
+    state = opt.init(params_half)
+    scaled = opt.scale_loss(loss, state)        # or scaler.value_and_grad
+    params, state, skipped = opt.step(grads_half, state, params_half)
+
+``skipped`` mirrors the reference's overflow bookkeeping
+(``optimizer.overflow`` attribute after ``step``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.fp16_utils.fp16util import model_grads_to_master_grads
+
+
+class FP16OptState(NamedTuple):
+    inner: Any           # wrapped optimizer state (holds fp32 masters)
+    scaler: ScalerState
+
+
+@dataclasses.dataclass(frozen=True)
+class FP16_Optimizer:
+    """Reference constructor shape: ``FP16_Optimizer(init_optimizer,
+    static_loss_scale=1.0, dynamic_loss_scale=False,
+    dynamic_loss_args=None, verbose=True)``."""
+
+    init_optimizer: Any
+    static_loss_scale: float = 1.0
+    dynamic_loss_scale: bool = False
+    verbose: bool = True  # parity knob; logging rides amp's gates
+
+    def __post_init__(self):
+        inner = self.init_optimizer.with_master_weights(True)
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(
+            self, "_scaler",
+            LossScaler("dynamic" if self.dynamic_loss_scale
+                       else float(self.static_loss_scale)))
+
+    @property
+    def optimizer(self):
+        """The wrapped fused optimizer (reference attribute name)."""
+        return self._inner
+
+    @property
+    def loss_scaler(self) -> LossScaler:
+        return self._scaler
+
+    def loss_scale(self, state: FP16OptState) -> jnp.ndarray:
+        return state.scaler.loss_scale
+
+    def init(self, params) -> FP16OptState:
+        return FP16OptState(
+            inner=self._inner.init(params),
+            scaler=self._scaler.init(),
+        )
+
+    def scale_loss(self, loss, state: FP16OptState):
+        """Reference ``optimizer.backward(loss)`` scales the loss before
+        autodiff; functionally: scale the loss value (use inside your
+        loss fn, or use ``loss_scaler.value_and_grad``)."""
+        return self._scaler.scale(loss, state.scaler)
+
+    def step(self, grads, state: FP16OptState, params, lr=None):
+        """Unscale → overflow check → (maybe) fused master step → new
+        model params. Returns ``(params, state, skipped)`` where
+        ``skipped`` is the traced overflow bool (reference
+        ``optimizer.overflow``)."""
+        master_grads = model_grads_to_master_grads(grads)
+        unscaled, found_inf = self._scaler.unscale(
+            master_grads, state.scaler)
+        new_params, new_inner = self._inner.step(
+            unscaled, state.inner, params, skip_if=found_inf, lr=lr)
+        new_scaler = self._scaler.update(state.scaler, found_inf)
+        return new_params, FP16OptState(new_inner, new_scaler), found_inf
+
+    # reference state_dict surface: the scaler + step counters round-trip
+    def state_dict(self, state: FP16OptState):
+        return {
+            "loss_scaler": {
+                "loss_scale": state.scaler.loss_scale,
+                "unskipped": state.scaler.unskipped,
+                "steps_skipped": state.scaler.steps_skipped,
+            },
+            "optimizer_state": state.inner,
+        }
+
+    def load_state_dict(self, sd) -> FP16OptState:
+        return FP16OptState(
+            inner=sd["optimizer_state"],
+            scaler=ScalerState(
+                loss_scale=jnp.asarray(sd["loss_scaler"]["loss_scale"],
+                                       jnp.float32),
+                unskipped=jnp.asarray(sd["loss_scaler"]["unskipped"],
+                                      jnp.int32),
+                steps_skipped=jnp.asarray(
+                    sd["loss_scaler"]["steps_skipped"], jnp.int32),
+            ),
+        )

@@ -1,0 +1,73 @@
+"""Legacy fp16 helpers (reference: ``apex/fp16_utils/fp16util.py``,
+SURVEY.md §2.1).
+
+The reference predates amp: ``network_to_half`` casts a model in place,
+``prep_param_lists`` builds (model, fp32 master) parameter pairs, and
+``master_params_to_model_params``/``model_grads_to_master_grads`` copy
+between them around an fp32 optimizer step. Functionally the same
+surface on pytrees — model "halves" are new pytrees, masters are fp32
+copies (optionally one flat buffer, the reference's ``flat_master``).
+
+On TPU the native half type is bfloat16, so that is the default
+``half_dtype``; pass ``jnp.float16`` for literal fp16 parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import ravel_list, tree_cast, unravel_list
+
+
+def _ravel_f32(tree):
+    """Flatten to one fp32 buffer (the apex_C.flatten analog)."""
+    flat, _ = ravel_list(
+        [l.astype(jnp.float32) for l in jax.tree.leaves(tree)])
+    return flat
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Cast every floating leaf to the half dtype (reference
+    ``network_to_half``; BN params are the classic exception there —
+    handled by amp's ``keep_batchnorm_fp32``, not this legacy helper)."""
+    return tree_cast(params, half_dtype)
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """Build (model_params, master_params) (reference ``prep_param_lists``).
+
+    ``flat_master=True`` returns the master as ONE flat fp32 vector (the
+    reference flattens via ``_flatten_dense_tensors``); otherwise a
+    same-structure fp32 pytree.
+    """
+    if flat_master:
+        return params, _ravel_f32(params)
+    return params, tree_cast(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params, master_params,
+                                  flat_master: bool = False):
+    """Copy master values into the model dtypes (reference name); returns
+    the new model pytree (functional — no in-place .data copies)."""
+    if flat_master:
+        meta = [(l.shape, l.dtype, l.size)
+                for l in jax.tree.leaves(model_params)]
+        leaves = unravel_list(master_params, meta)
+        return jax.tree.unflatten(jax.tree.structure(model_params), leaves)
+    return jax.tree.map(lambda mp, m: m.astype(mp.dtype),
+                        model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads, flat_master: bool = False):
+    """Cast model grads to fp32 master grads (reference name)."""
+    if flat_master:
+        return _ravel_f32(model_grads)
+    return tree_cast(model_grads, jnp.float32)
+
+
+def to_python_float(t) -> float:
+    """Reference helper: scalar device value → host float."""
+    return float(jax.device_get(t))

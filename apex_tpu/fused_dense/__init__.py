@@ -1,0 +1,9 @@
+"""apex.fused_dense parity surface (reference: ``apex/fused_dense``)."""
+
+from apex_tpu.fused_dense.fused_dense import (
+    DenseNoBias,
+    FusedDense,
+    FusedDenseGeluDense,
+)
+
+__all__ = ["DenseNoBias", "FusedDense", "FusedDenseGeluDense"]

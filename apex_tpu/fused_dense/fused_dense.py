@@ -1,0 +1,73 @@
+"""Fused dense layers (reference: ``apex/fused_dense/fused_dense.py`` +
+``csrc/fused_dense.cpp``/``fused_dense_cuda.cu``, SURVEY.md §2.1/§2.2).
+
+The reference wraps cublasLt GEMM epilogues (bias, bias+gelu) so the
+bias/activation rides inside the GEMM kernel. XLA performs the same
+epilogue fusion on the jitted graph, so these modules provide the
+reference's API shape — ``FusedDense``, ``DenseNoBias``,
+``FusedDenseGeluDense`` — over plain ``jnp`` matmuls with fp32
+accumulation on the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FusedDense(nn.Module):
+    """Linear + bias in one fused pass (reference ``FusedDense``)."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.in_features, self.out_features), self.params_dtype)
+        y = jnp.matmul(x, kernel.astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+        if self.bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.out_features,), self.params_dtype)
+            y = y + b.astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
+class DenseNoBias(nn.Module):
+    """Reference ``DenseNoBias``: GEMM only."""
+
+    in_features: int
+    out_features: int
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.in_features, self.out_features), self.params_dtype)
+        return jnp.matmul(x, kernel.astype(x.dtype),
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Linear+bias → GELU → Linear+bias (reference
+    ``FusedDenseGeluDense``, the transformer-MLP shape the cublasLt
+    epilogue chain targets)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = FusedDense(self.in_features, self.intermediate_features,
+                       params_dtype=self.params_dtype, name="dense1")(x)
+        h = jax.nn.gelu(h)
+        return FusedDense(self.intermediate_features, self.out_features,
+                          params_dtype=self.params_dtype, name="dense2")(h)

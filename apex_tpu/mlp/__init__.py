@@ -1,0 +1,5 @@
+"""apex.mlp parity surface (reference: ``apex/mlp/__init__.py``)."""
+
+from apex_tpu.mlp.mlp import MLP
+
+__all__ = ["MLP"]

@@ -1,0 +1,74 @@
+"""Fused MLP (reference: ``apex/mlp/mlp.py`` + ``csrc/mlp.cpp``/
+``mlp_cuda.cu``, SURVEY.md §2.1/§2.2).
+
+The reference exists because eager torch launches one GEMM + one bias +
+one activation kernel per layer; its CUDA ext runs the whole chain in one
+call. Under XLA the jitted chain IS the fused program (GEMM + bias +
+activation epilogues fuse into the matmul), so the module's job here is
+pure API parity: the ``mlp_sizes`` constructor shape, ``bias``/
+``activation`` knobs, and flat ``weights``/``biases`` attribute access.
+
+Matmuls carry ``preferred_element_type=fp32`` so bf16 activations hit the
+MXU with fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fused_dense import FusedDense
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "gelu": jax.nn.gelu,  # extension over the reference's {none,relu,sigmoid}
+}
+
+
+class MLP(nn.Module):
+    """Chain of Linear(+bias)(+activation) layers.
+
+    Reference constructor: ``MLP(mlp_sizes, bias=True, relu=True,
+    activation='relu')`` — ``mlp_sizes[0]`` is the input width, each
+    subsequent entry a layer output width. The activation is applied
+    after every layer except the last (reference ``mlp.cpp`` semantics).
+
+    Layers are :class:`~apex_tpu.fused_dense.FusedDense`, so bf16
+    activations run single-pass MXU matmuls with fp32 accumulation.
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    params_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        if len(self.mlp_sizes) < 2:
+            raise ValueError("mlp_sizes needs an input size and >=1 layer")
+        if self.activation not in _ACTIVATIONS:
+            raise ValueError(
+                f"activation must be one of {sorted(_ACTIVATIONS)}, "
+                f"got {self.activation!r}")
+        self.layers = [
+            FusedDense(
+                self.mlp_sizes[i],
+                out,
+                bias=self.bias,
+                params_dtype=self.params_dtype,
+                name=f"layer_{i}",
+            )
+            for i, out in enumerate(self.mlp_sizes[1:])
+        ]
+
+    def __call__(self, x):
+        act = _ACTIVATIONS[self.activation]
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            y = layer(x)
+            x = act(y) if i < n - 1 else y
+        return x

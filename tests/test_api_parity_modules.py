@@ -1,0 +1,201 @@
+"""Parity tests for the small API-parity modules: apex.mlp,
+apex.fused_dense, contrib xentropy, contrib clip_grad (upstream analogs:
+tests/L0/run_mlp, tests/L0/run_fused_dense, contrib/test/xentropy —
+fused-vs-composed numerical equivalence, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.xentropy import (
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)
+from apex_tpu.fused_dense import DenseNoBias, FusedDense, FusedDenseGeluDense
+from apex_tpu.mlp import MLP
+
+
+# ---------------------------------------------------------------- mlp
+
+def test_mlp_matches_composed():
+    sizes = (16, 32, 24, 8)
+    model = MLP(sizes, bias=True, activation="relu")
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16).astype("float32"))
+    params = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(params, x)
+
+    h = x
+    layers = params["params"]
+    for i in range(3):
+        w = layers[f"layer_{i}"]["kernel"]
+        b = layers[f"layer_{i}"]["bias"]
+        h = h @ w + b
+        if i < 2:
+            h = jax.nn.relu(h)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(h), rtol=1e-6)
+
+
+def test_mlp_grads_flow_and_no_bias():
+    model = MLP((8, 8, 4), bias=False, activation="sigmoid")
+    x = jnp.ones((2, 8))
+    params = model.init(jax.random.PRNGKey(1), x)
+    g = jax.grad(lambda p: jnp.sum(model.apply(p, x)))(params)
+    assert all(bool(jnp.any(l != 0)) for l in jax.tree.leaves(g))
+    assert "bias" not in params["params"]["layer_0"]
+
+
+def test_mlp_validation():
+    with pytest.raises(ValueError):
+        MLP((16,)).init(jax.random.PRNGKey(0), jnp.ones((1, 16)))
+    with pytest.raises(ValueError):
+        MLP((16, 8), activation="tanh").init(
+            jax.random.PRNGKey(0), jnp.ones((1, 16)))
+
+
+# -------------------------------------------------------- fused_dense
+
+def test_fused_dense_matches_composed():
+    layer = FusedDense(12, 20)
+    x = jnp.asarray(np.random.RandomState(0).randn(5, 12).astype("float32"))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    ref = x @ params["params"]["kernel"] + params["params"]["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)
+
+
+def test_dense_no_bias():
+    layer = DenseNoBias(6, 3)
+    x = jnp.ones((2, 6))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    assert set(params["params"].keys()) == {"kernel"}
+    y = layer.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x @ params["params"]["kernel"]),
+                               rtol=1e-6)
+
+
+def test_fused_dense_gelu_dense_matches_composed():
+    layer = FusedDenseGeluDense(8, 32, 8)
+    x = jnp.asarray(np.random.RandomState(1).randn(3, 8).astype("float32"))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    y = layer.apply(params, x)
+    p = params["params"]
+    h = x @ p["dense1"]["kernel"] + p["dense1"]["bias"]
+    h = jax.nn.gelu(h)
+    ref = h @ p["dense2"]["kernel"] + p["dense2"]["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_dense_bf16_io():
+    layer = FusedDense(8, 8)
+    x = jnp.ones((2, 8), jnp.bfloat16)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    assert layer.apply(params, x).dtype == jnp.bfloat16
+
+
+# ------------------------------------------------------------ xentropy
+
+def test_xentropy_matches_log_softmax():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(6, 50).astype("float32"))
+    labels = jnp.asarray(rng.randint(1, 50, 6))
+    loss = softmax_cross_entropy_loss(logits, labels)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5)
+
+
+def test_xentropy_label_smoothing():
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(4, 20).astype("float32"))
+    labels = jnp.asarray(rng.randint(1, 20, 4))
+    eps = 0.1
+    loss = SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing=eps)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    # smoothed target: (1-eps) one-hot + eps/V uniform
+    smooth = -jnp.mean(logp, axis=-1)
+    ref = (1 - eps) * nll + eps * smooth
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5)
+
+
+def test_xentropy_padding_idx_and_grad():
+    logits = jnp.asarray(np.random.RandomState(2).randn(4, 10)
+                         .astype("float32"))
+    labels = jnp.asarray([3, 0, 5, 0])  # padding_idx=0 rows → zero loss
+    loss = softmax_cross_entropy_loss(logits, labels, padding_idx=0)
+    assert float(loss[1]) == 0.0 and float(loss[3]) == 0.0
+    assert float(loss[0]) > 0.0
+
+    g = jax.grad(lambda l: jnp.sum(
+        softmax_cross_entropy_loss(l, labels, padding_idx=0)))(logits)
+    # padded rows contribute no gradient
+    np.testing.assert_allclose(np.asarray(g[1]), 0.0, atol=1e-7)
+    # live rows: softmax - one_hot
+    probs = jax.nn.softmax(logits[0])
+    expect = probs - jax.nn.one_hot(3, 10)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_xentropy_half_to_float():
+    logits = jnp.ones((2, 8), jnp.bfloat16)
+    labels = jnp.asarray([1, 2])
+    assert softmax_cross_entropy_loss(
+        logits, labels, half_to_float=True).dtype == jnp.float32
+    assert softmax_cross_entropy_loss(
+        logits, labels).dtype == jnp.bfloat16
+
+
+# ----------------------------------------------------------- clip_grad
+
+def _grad_tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": jnp.asarray(rng.randn(5, 3).astype("float32")),
+        "b": {"c": jnp.asarray(rng.randn(7).astype("float32"))},
+    }
+
+
+def test_clip_grad_norm_clips():
+    grads = _grad_tree()
+    flat = np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree.leaves(grads)])
+    true_norm = float(np.linalg.norm(flat))
+    max_norm = true_norm / 2
+
+    clipped, total = clip_grad_norm_(grads, max_norm)
+    np.testing.assert_allclose(float(total), true_norm, rtol=1e-5)
+    new_flat = np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(clipped)])
+    np.testing.assert_allclose(np.linalg.norm(new_flat), max_norm,
+                               rtol=1e-4)
+
+
+def test_clip_grad_norm_noop_below_threshold():
+    grads = _grad_tree()
+    clipped, total = clip_grad_norm_(grads, 1e9)
+    for a, b in zip(jax.tree.leaves(clipped), jax.tree.leaves(grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_clip_grad_norm_inf_norm():
+    grads = _grad_tree()
+    flat = np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree.leaves(grads)])
+    _, total = clip_grad_norm_(grads, 1.0, norm_type=float("inf"))
+    np.testing.assert_allclose(float(total), np.abs(flat).max(), rtol=1e-6)
+
+
+def test_clip_grad_norm_jit_composes():
+    grads = _grad_tree()
+
+    @jax.jit
+    def f(g):
+        return clip_grad_norm_(g, 1.0)
+
+    clipped, total = f(grads)
+    assert np.isfinite(float(total))

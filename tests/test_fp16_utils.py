@@ -1,0 +1,137 @@
+"""fp16_utils tests (upstream analog: tests/distributed/amp_master_params
+master↔model consistency + the legacy FP16_Optimizer smoke paths,
+SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+)
+from apex_tpu.optimizers import FusedSGD
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "w": jnp.asarray(rng.randn(4, 3).astype("float32")),
+        "b": jnp.asarray(rng.randn(3).astype("float32")),
+        "step": jnp.asarray(3, jnp.int32),  # non-float leaves pass through
+    }
+
+
+def test_network_to_half_and_back():
+    p = _params()
+    h = network_to_half(p)
+    assert h["w"].dtype == jnp.bfloat16
+    assert h["step"].dtype == jnp.int32  # untouched
+    h16 = network_to_half(p, jnp.float16)
+    assert h16["w"].dtype == jnp.float16
+
+
+def test_prep_param_lists_roundtrip():
+    p = network_to_half(_params())
+    model, master = prep_param_lists(p)
+    assert master["w"].dtype == jnp.float32
+    back = master_params_to_model_params(model, master)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(model)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_flat_master_roundtrip():
+    p = network_to_half({"w": jnp.ones((2, 3)), "b": jnp.zeros((5,))})
+    model, flat = prep_param_lists(p, flat_master=True)
+    assert flat.shape == (11,) and flat.dtype == jnp.float32
+    back = master_params_to_model_params(model, flat, flat_master=True)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(model)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    g = model_grads_to_master_grads(model, flat_master=True)
+    assert g.shape == (11,) and g.dtype == jnp.float32
+
+
+def test_fp16_optimizer_master_model_consistency():
+    """The reference's amp_master_params check: after steps, model params
+    equal masters cast to model dtype."""
+    params = network_to_half({"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))})
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), static_loss_scale=128.0)
+    state = opt.init(params)
+
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 128.0, params)  # scaled
+    p = params
+    for _ in range(3):
+        p, state, skipped = opt.step(grads, state, p)
+        assert not bool(skipped)
+    masters = state.inner.master
+    cast = jax.tree.map(lambda mp, m: m.astype(mp.dtype), p, masters)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(cast)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    # 3 steps of lr 0.1 on unit (unscaled) grads from 1.0 → 0.7
+    np.testing.assert_allclose(np.asarray(p["w"], np.float32), 0.7,
+                               rtol=1e-2)
+
+
+def test_fp16_optimizer_dynamic_scale_backoff():
+    params = network_to_half({"w": jnp.ones((2, 2))})
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+    state = opt.init(params)
+    assert float(opt.loss_scale(state)) == 2.0 ** 16
+
+    bad = {"w": jnp.full((2, 2), jnp.inf, jnp.bfloat16)}
+    p, state, skipped = opt.step(bad, state, params)
+    assert bool(skipped)
+    assert float(opt.loss_scale(state)) == 2.0 ** 15
+    np.testing.assert_array_equal(np.asarray(p["w"], np.float32),
+                                  np.asarray(params["w"], np.float32))
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    params = network_to_half({"w": jnp.ones((2, 2))})
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+    state = opt.init(params)
+    bad = {"w": jnp.full((2, 2), jnp.inf, jnp.bfloat16)}
+    _, state, _ = opt.step(bad, state, params)
+
+    sd = opt.state_dict(state)
+    restored = opt.load_state_dict(jax.tree.map(np.asarray, sd))
+    assert float(restored.scaler.loss_scale) == float(state.scaler.loss_scale)
+    assert int(restored.scaler.steps_skipped) == 1
+
+
+def test_fp16_optimizer_jit_scaled_loss_loop():
+    """End-to-end: scaled loss -> grads -> step inside jit; loss falls."""
+    params = network_to_half({"w": jnp.asarray(
+        np.random.RandomState(0).randn(8, 1).astype("float32") * 0.5)})
+    X = jnp.asarray(np.random.RandomState(1).randn(32, 8).astype("float32"))
+    y = X @ np.random.RandomState(2).randn(8, 1).astype("float32")
+    opt = FP16_Optimizer(FusedSGD(lr=0.05), dynamic_loss_scale=True)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        pred = X.astype(jnp.bfloat16) @ p["w"]
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    @jax.jit
+    def train_step(p, state):
+        # legacy flow: backward() on the SCALED loss; step() unscales
+        def scaled(p):
+            return opt.scale_loss(loss_fn(p), state)
+
+        loss_scaled, grads = jax.value_and_grad(scaled)(p)
+        p2, state2, _ = opt.step(grads, state, p)
+        return p2, state2, loss_scaled / state.scaler.loss_scale
+
+    losses = []
+    p = params
+    for _ in range(25):
+        p, state, l = train_step(p, state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5
