@@ -22,8 +22,10 @@ class AmpState:
         self.ingraph_logging = None
 
     def maybe_print(self, msg: str, rank0: bool = False):
+        # stdout, like the reference's plain print() — downstream scripts
+        # grep training stdout for the overflow line
         if self.verbosity >= 1:
-            print(msg, file=sys.stderr)
+            print(msg)
 
 
 _amp_state = AmpState()

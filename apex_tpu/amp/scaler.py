@@ -171,6 +171,46 @@ class LossScaler:
         tree = tree_select(found_inf, old_tree, updated_tree)
         return tree, self.update(state, found_inf)
 
+    # -- observability -----------------------------------------------------
+
+    @staticmethod
+    def metrics(state: ScalerState, grad_norm=None, loss=None) -> dict:
+        """Per-step metrics dict (SURVEY.md §5 metrics row): the values a
+        training harness logs each step. Traced values in, traced values
+        out — call inside jit and log on the host after the step."""
+        out = {
+            "loss_scale": state.loss_scale,
+            "unskipped": state.unskipped,
+            "steps_skipped": state.steps_skipped,
+        }
+        if grad_norm is not None:
+            out["grad_norm"] = grad_norm
+        if loss is not None:
+            out["loss"] = loss
+        return out
+
+    def host_overflow_report(self, prev_state: ScalerState,
+                             new_state: ScalerState) -> bool:
+        """Host-side fallback for the contractual overflow line.
+
+        The in-graph ``jax.debug.print`` path in :meth:`update` needs
+        host callbacks, which some TPU runtimes (axon PJRT) reject — so
+        on those runtimes the line downstream scripts grep for would
+        never print. Call this AFTER the step with the device states
+        (one small host readback): if the step was skipped, it prints
+        the reference's exact line and returns True. When the in-graph
+        path is active it already printed the line; this only reports
+        the boolean (no double line for grep-and-count consumers).
+        """
+        skipped = int(new_state.steps_skipped) > int(prev_state.steps_skipped)
+        if skipped and not _amp_state.ingraph_logging_enabled():
+            _amp_state.maybe_print(
+                "Gradient overflow.  Skipping step, loss scaler "
+                f"{self.loss_id} reducing loss scale to "
+                f"{float(new_state.loss_scale)}"
+            )
+        return skipped
+
 
 # Backwards-handy aliases mirroring apex naming.
 DynamicLossScaler = LossScaler

@@ -1,0 +1,87 @@
+"""Checkpoint round-trip for (params, optimizer state, scaler state).
+
+SURVEY.md §5 checkpoint/resume row: the reference's contractual surface
+is small — ``amp.state_dict()`` round-trips loss-scaler state, and
+optimizers expose ``state_dict`` with step counts — but a real training
+harness needs the full (params, opt_state, scaler_state) triple on disk.
+The TPU-native answer is orbax over a single flat pytree, which
+preserves shardings and restores on any topology.
+
+Usage::
+
+    save_checkpoint(dir, step, params=params, opt_state=state,
+                    scaler_state=scaler_state)
+    restored = load_checkpoint(dir, step=None,  # None = latest
+                               template=dict(params=params,
+                                             opt_state=state,
+                                             scaler_state=scaler_state))
+
+The template supplies structure (NamedTuples, dtypes) for restore; pass
+abstract ``jax.eval_shape`` results to avoid materializing a throwaway
+tree. ``amp.state_dict()`` remains the scaler-only reference-shaped
+surface; this helper is the full-training-state tier above it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def checkpoint_path(directory: str, step: int) -> str:
+    return os.path.join(os.fspath(directory), f"step_{step:09d}")
+
+
+def save_checkpoint(directory: str, step: int, **trees) -> str:
+    """Save named pytrees (params=..., opt_state=..., scaler_state=...)
+    as one checkpoint under ``directory/step_NNNNNNNNN``. Returns the
+    path. Overwrites an existing checkpoint at the same step (resume
+    after preemption re-saves the same step)."""
+    path = checkpoint_path(directory, step)
+    payload = {k: v for k, v in trees.items() if v is not None}
+    payload["_step"] = step
+    _checkpointer().save(path, payload, force=True)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest step with a checkpoint in ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    template: Optional[Any] = None):
+    """Restore a checkpoint (``step=None`` → latest).
+
+    ``template`` is a pytree of arrays or ShapeDtypeStructs with the
+    SAME named-tree structure passed to :func:`save_checkpoint`; it
+    restores container types (NamedTuples) that serialization flattens.
+    Returns the restored dict of trees (plus ``_step``).
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    path = checkpoint_path(directory, step)
+    if template is not None:
+        item = dict(template)
+        item["_step"] = step
+        return _checkpointer().restore(path, item=item)
+    return _checkpointer().restore(path)

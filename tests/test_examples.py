@@ -1,0 +1,50 @@
+"""Examples tier smoke tests (upstream analog: tests/L1 driving
+examples/imagenet/main_amp.py through opt levels, SURVEY.md §4) — run
+in-process on the CPU sim with tiny step counts."""
+
+import sys
+
+import pytest
+
+
+def _run(module_main, argv):
+    old = sys.argv
+    sys.argv = argv
+    try:
+        return module_main()
+    finally:
+        sys.argv = old
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_train_mnist_all_opt_levels(opt_level, capsys):
+    from examples.train_mnist import main
+
+    final = _run(main, ["train_mnist", "--opt-level", opt_level,
+                        "--steps", "25", "--batch-size", "64"])
+    out = capsys.readouterr().out
+    assert final < 0.5  # separable blobs: loss collapses fast
+    if opt_level in ("O1", "O2"):
+        # dynamic scaling default: the injected inf must print the line
+        assert "Gradient overflow.  Skipping step, loss scaler 0" in out
+
+
+def test_train_mnist_checkpoint_resume(tmp_path, capsys):
+    from examples.train_mnist import main
+
+    d = str(tmp_path / "ck")
+    _run(main, ["train_mnist", "--steps", "10", "--inject-inf-at", "-1",
+                "--ckpt-dir", d])
+    _run(main, ["train_mnist", "--steps", "10", "--inject-inf-at", "-1",
+                "--ckpt-dir", d])
+    out = capsys.readouterr().out
+    assert "resumed from step 10" in out
+
+
+def test_train_bert_tiny(capsys):
+    from examples.train_bert import main
+
+    _run(main, ["train_bert", "--config", "tiny", "--steps", "3",
+                "--batch-size", "2", "--seq", "64"])
+    out = capsys.readouterr().out
+    assert "ms/step" in out
