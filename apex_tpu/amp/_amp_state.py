@@ -8,8 +8,6 @@ is carried functionally; only human-facing verbosity lives here.
 
 from __future__ import annotations
 
-import sys
-
 
 class AmpState:
     def __init__(self):

@@ -199,17 +199,32 @@ class LossScaler:
         never print. Call this AFTER the step with the device states
         (one small host readback): if the step was skipped, it prints
         the reference's exact line and returns True. When the in-graph
-        path is active it already printed the line; this only reports
-        the boolean (no double line for grep-and-count consumers).
+        path already printed the line (dynamic scaler + callback-capable
+        runtime), this only reports the boolean — no double line for
+        grep-and-count consumers. Static scalers never print in-graph
+        (``update`` early-returns), so their line always comes from here,
+        and without the "reducing" clause (a static scale never backs
+        off).
         """
         skipped = int(new_state.steps_skipped) > int(prev_state.steps_skipped)
-        if skipped and not _amp_state.ingraph_logging_enabled():
-            _amp_state.maybe_print(
-                "Gradient overflow.  Skipping step, loss scaler "
-                f"{self.loss_id} reducing loss scale to "
-                f"{float(new_state.loss_scale)}"
-            )
-        return skipped
+        if not skipped:
+            return False
+        ingraph_already = (self.dynamic
+                           and _amp_state.ingraph_logging_enabled())
+        if not ingraph_already:
+            if self.dynamic:
+                _amp_state.maybe_print(
+                    "Gradient overflow.  Skipping step, loss scaler "
+                    f"{self.loss_id} reducing loss scale to "
+                    f"{float(new_state.loss_scale)}"
+                )
+            else:
+                _amp_state.maybe_print(
+                    "Gradient overflow.  Skipping step, loss scaler "
+                    f"{self.loss_id} static loss scale "
+                    f"{float(new_state.loss_scale)} unchanged"
+                )
+        return True
 
 
 # Backwards-handy aliases mirroring apex naming.

@@ -6,3 +6,4 @@ from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import functional  # noqa: F401
+from apex_tpu.transformer import microbatches  # noqa: F401

@@ -3,7 +3,9 @@ pipelining (SURVEY.md §2.3 PP row)."""
 
 from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
 from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
     spmd_pipeline,
+    spmd_pipeline_interleaved,
 )

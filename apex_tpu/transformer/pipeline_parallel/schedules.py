@@ -48,6 +48,57 @@ def _shift_right(x, axis_name, pp):
     return p2p_communication.send_forward(x, axis_name)
 
 
+def _infer_carry_mark(fn, probe_params, microbatches, axis, name):
+    """Varying-axes set for the scan carry + stage_fn shape validation.
+
+    The carry is device-varying from tick 1 on (ppermute), and the stage
+    fn may introduce MORE varying axes (e.g. TP collectives inside the
+    stage make activations tensor-varying). The scan needs a stable
+    carry type, so infer the fixed point of the stage fn's output
+    varying-set via eval_shape (abstract — no compute is added). The
+    first probe also validates the shape/dtype-preservation contract.
+    """
+    from apex_tpu.utils.collectives import mark_varying
+
+    mb_shape = microbatches.shape[1:]
+    try:
+        mb_vma = frozenset(jax.typeof(microbatches).vma)
+    except (AttributeError, TypeError):
+        mb_vma = frozenset()
+    vma = frozenset({axis}) | mb_vma  # injected microbatches carry their own
+    converged = False
+    for it in range(4):  # the varying-set only grows and mesh axes are few
+        def _probe(vma=vma):
+            x = mark_varying(jnp.zeros(mb_shape, microbatches.dtype),
+                             tuple(vma))
+            return fn(probe_params, x, jnp.int32(0))
+
+        out_spec = jax.eval_shape(_probe)
+        if it == 0 and (out_spec.shape, out_spec.dtype) != (
+                mb_shape, microbatches.dtype):
+            raise ValueError(
+                f"{name} stage_fn must preserve the microbatch "
+                f"shape/dtype (the scan carry): got {out_spec.shape}/"
+                f"{out_spec.dtype} from input {mb_shape}/"
+                f"{microbatches.dtype}. Fold shape-changing ops (embedding "
+                "lookup, logit projection) inside the first/last stage's "
+                "fn, gated on axis_index."
+            )
+        out_vma = frozenset(getattr(out_spec, "vma", ())) | vma
+        if out_vma == vma:
+            converged = True
+            break
+        vma = out_vma
+    if not converged:
+        raise RuntimeError(
+            f"{name} could not infer a stable varying-axes set for "
+            f"the scan carry (last iterate: {sorted(vma)}). The stage_fn's "
+            "output varying-set must reach a fixed point; check for "
+            "collectives over axes not in the current mesh."
+        )
+    return tuple(vma)
+
+
 def spmd_pipeline(
     stage_fn: Callable,
     stage_params,
@@ -122,48 +173,10 @@ def spmd_pipeline(
         state = _shift_right(y, axis, pp) if pp > 1 else y
         return (state, outputs), None
 
-    # The carry is device-varying from tick 1 on (ppermute), and the stage
-    # fn may introduce MORE varying axes (e.g. TP collectives inside the
-    # stage make activations tensor-varying). The scan needs a stable carry
-    # type, so infer the fixed point of the stage fn's output varying-set
-    # via eval_shape (abstract — no compute is added).
     from apex_tpu.utils.collectives import mark_varying
 
-    try:
-        mb_vma = frozenset(jax.typeof(microbatches).vma)
-    except (AttributeError, TypeError):
-        mb_vma = frozenset()
-    vma = frozenset({axis}) | mb_vma  # injected microbatches carry their own
-    converged = False
-    for it in range(4):  # the varying-set only grows and mesh axes are few
-        def _probe(vma=vma):
-            x = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), tuple(vma))
-            return fn(stage_params, x, jnp.int32(0))
-
-        out_spec = jax.eval_shape(_probe)
-        if it == 0 and (out_spec.shape, out_spec.dtype) != (
-                mb_shape, microbatches.dtype):
-            raise ValueError(
-                "spmd_pipeline stage_fn must preserve the microbatch "
-                f"shape/dtype (the scan carry): got {out_spec.shape}/"
-                f"{out_spec.dtype} from input {mb_shape}/"
-                f"{microbatches.dtype}. Fold shape-changing ops (embedding "
-                "lookup, logit projection) inside the first/last stage's "
-                "fn, gated on axis_index."
-            )
-        out_vma = frozenset(getattr(out_spec, "vma", ())) | vma
-        if out_vma == vma:
-            converged = True
-            break
-        vma = out_vma
-    if not converged:
-        raise RuntimeError(
-            "spmd_pipeline could not infer a stable varying-axes set for "
-            f"the scan carry (last iterate: {sorted(vma)}). The stage_fn's "
-            "output varying-set must reach a fixed point; check for "
-            "collectives over axes not in the current mesh."
-        )
-    mark = tuple(vma)
+    mark = _infer_carry_mark(fn, stage_params, microbatches, axis,
+                             "spmd_pipeline")
 
     init_state = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), mark)
     init_out = mark_varying(
@@ -217,8 +230,161 @@ def forward_backward_pipelining_without_interleaving(
     return loss, grads
 
 
+def spmd_pipeline_interleaved(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    num_microbatches: int,
+    num_model_chunks: int,
+    remat: bool = True,
+    axis_name: Optional[str] = None,
+):
+    """Interleaved (virtual-pipeline) forward pass as a CIRCULAR pipeline.
+
+    Reference: the interleaved path of
+    ``forward_backward_pipelining_with_interleaving`` — each device owns
+    ``v = num_model_chunks`` model chunks; global stage ``c*pp + r``
+    lives on device ``r``. The reference cuts the bubble from
+    ``(pp-1)/m`` to ``(pp-1)/(v*m)`` by interleaving chunk compute; the
+    SPMD dataflow analog is a circular schedule: microbatches travel the
+    device ring ``v`` times, re-entering device 0 at the next chunk one
+    tick after leaving device ``pp-1`` (the ppermute wraparound delivers
+    exactly on time), in groups of ``pp`` so every device computes one
+    (chunk, microbatch) pair per tick with no conflicts.
+
+    Tick math (``u = t - stage``, the device's stream position):
+    ``group = u // (v*pp)``, ``chunk = (u % (v*pp)) // pp``,
+    ``mb = group*pp + u % pp``. Total ticks ``v*m + pp - 1`` — the
+    bubble is ``pp - 1`` single-CHUNK units vs the non-interleaved
+    schedule's ``pp - 1`` whole-stage (= v-chunk) units: the 1/v bubble
+    reduction the reference's interleaving exists for.
+
+    Args:
+      stage_fn: ``(chunk_params, x, microbatch_index) -> x`` — ONE model
+        chunk's compute (shape/dtype-preserving, as in spmd_pipeline).
+      stage_params: pytree whose leaves carry a leading
+        ``num_model_chunks`` axis: this device's v chunk params.
+      microbatches: (num_microbatches, mb, ...); num_microbatches must
+        be divisible by pp (the reference asserts the same for its
+        interleaved schedule).
+
+    Returns:
+      (num_microbatches, mb, ...) final-chunk outputs, valid on the last
+      stage (as in spmd_pipeline).
+    """
+    axis = axis_name or _axis()
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+    v = int(num_model_chunks)
+    if v < 1:
+        raise ValueError(f"num_model_chunks must be >= 1, got {v}")
+    if num_microbatches % pp != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches "
+            f"({num_microbatches}) divisible by pipeline world size ({pp}), "
+            "matching the reference assertion")
+    stage = jax.lax.axis_index(axis)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    chunk0 = jax.tree.map(
+        lambda p: jax.lax.index_in_dim(p, 0, keepdims=False), stage_params)
+    mb_shape = microbatches.shape[1:]
+    total_ticks = v * num_microbatches + pp - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        u = t - stage
+        group = u // (v * pp)
+        within = u % (v * pp)
+        chunk = within // pp
+        mb_idx = group * pp + u % pp
+        active = (u >= 0) & (mb_idx >= 0) & (mb_idx < num_microbatches)
+
+        chunk_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, jnp.clip(chunk, 0, v - 1), keepdims=False),
+            stage_params)
+
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(mb_idx, 0, num_microbatches - 1),
+            keepdims=False)
+        x_in = jnp.where((stage == 0) & (chunk == 0), inject, state)
+
+        y = fn(chunk_params, x_in, mb_idx)
+        y = jnp.where(active, y, state)
+
+        record = (stage == pp - 1) & (chunk == v - 1) & active
+        out_idx = jnp.clip(mb_idx, 0, num_microbatches - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(record, y,
+                      jax.lax.dynamic_index_in_dim(outputs, out_idx,
+                                                   keepdims=False)),
+            out_idx,
+            axis=0,
+        )
+
+        state = _shift_right(y, axis, pp) if pp > 1 else y
+        return (state, outputs), None
+
+    from apex_tpu.utils.collectives import mark_varying
+
+    mark = _infer_carry_mark(fn, chunk0, microbatches, axis,
+                             "spmd_pipeline_interleaved")
+
+    init_state = mark_varying(jnp.zeros(mb_shape, microbatches.dtype), mark)
+    init_out = mark_varying(
+        jnp.zeros((num_microbatches,) + mb_shape, microbatches.dtype), mark)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (init_state, init_out), jnp.arange(total_ticks)
+    )
+    return outputs
+
+
+def forward_backward_pipelining_with_interleaving(
+    forward_step_fn: Callable,
+    batch,
+    stage_params,
+    *,
+    num_microbatches: int,
+    loss_fn: Callable,
+    num_model_chunks: Optional[int] = None,
+    remat: bool = True,
+    axis_name: Optional[str] = None,
+):
+    """Interleaved 1F1B-equivalent loss + grads (reference name).
+
+    ``stage_params`` leaves carry a leading ``num_model_chunks`` axis
+    (inferred from the first leaf when not given). Loss is evaluated on
+    the last stage over final-chunk outputs; AD through the circular
+    scan produces the reverse interleaved schedule.
+    """
+    axis = axis_name or _axis()
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+    if num_model_chunks is None:
+        num_model_chunks = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def pipeline_loss(params):
+        outs = spmd_pipeline_interleaved(
+            forward_step_fn, params, batch,
+            num_microbatches=num_microbatches,
+            num_model_chunks=num_model_chunks, remat=remat, axis_name=axis,
+        )
+        per_mb = jax.vmap(loss_fn)(outs, jnp.arange(num_microbatches))
+        local = jnp.mean(per_mb)
+        stage = jax.lax.axis_index(axis)
+        return jax.lax.psum(jnp.where(stage == pp - 1, local, 0.0), axis)
+
+    loss, grads = jax.value_and_grad(pipeline_loss)(stage_params)
+    return loss, grads
+
+
 def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
                               pipeline_model_parallel_size=None):
-    """Reference dispatcher: interleaved scheduling is delegated to XLA's
-    scheduler here, so both cases map to the same SPMD pipeline."""
+    """Reference dispatcher: ``virtual_pipeline_model_parallel_size``
+    selects the interleaved (circular) schedule; otherwise the plain
+    SPMD pipeline."""
+    if (virtual_pipeline_model_parallel_size is not None
+            and virtual_pipeline_model_parallel_size > 1):
+        return forward_backward_pipelining_with_interleaving
     return forward_backward_pipelining_without_interleaving

@@ -37,7 +37,9 @@ def _checkpointer():
 
 
 def checkpoint_path(directory: str, step: int) -> str:
-    return os.path.join(os.fspath(directory), f"step_{step:09d}")
+    # orbax requires absolute paths ("Checkpoint path should be absolute")
+    return os.path.join(os.path.abspath(os.fspath(directory)),
+                        f"step_{step:09d}")
 
 
 def save_checkpoint(directory: str, step: int, **trees) -> str:

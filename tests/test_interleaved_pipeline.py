@@ -1,0 +1,191 @@
+"""Interleaved (virtual-pipeline) schedule + microbatch calculator tests
+(upstream analog: the interleaved path of
+test_pipeline_parallel_fwd_bwd.py and the microbatches calculator
+units; SURVEY.md §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+    destroy_microbatch_calculator,
+    get_num_microbatches,
+    setup_microbatch_calculator,
+    update_num_microbatches,
+)
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    spmd_pipeline_interleaved,
+)
+
+PP = 4
+V = 2   # model chunks per device -> 8 global stages
+M = 8   # microbatches (divisible by PP)
+MB = 2
+H = 8
+
+
+@pytest.fixture(autouse=True)
+def _mp():
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=1, pipeline_model_parallel_size_=PP
+    )
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def _chunk_weights(seed=0):
+    """One (H, H) matrix per GLOBAL stage: (V, PP, H, H) so that device r
+    chunk c holds global stage c*PP + r."""
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(V, PP, H, H).astype("float32") * 0.3)
+
+
+def _stage_fn(w, x, mb_idx):
+    return jnp.tanh(x @ w)
+
+
+def _batches(seed=1):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(M, MB, H).astype("float32"))
+
+
+def _sequential_ref(ws_vp, xs):
+    """Apply all V*PP global stages in order c*PP + r."""
+    h = xs
+    for c in range(V):
+        for r in range(PP):
+            h = jax.vmap(lambda x, w=ws_vp[c, r]: _stage_fn(w, x, 0))(h)
+    return h
+
+
+def test_interleaved_forward_matches_sequential():
+    ws = _chunk_weights()
+    xs = _batches()
+
+    def f(w_local, xs):
+        w = w_local.reshape(V, H, H)  # this device's V chunks
+        outs = spmd_pipeline_interleaved(
+            _stage_fn, w, xs, num_microbatches=M, num_model_chunks=V)
+        pp_rank = jax.lax.axis_index("pipeline")
+        return jax.lax.psum(jnp.where(pp_rank == PP - 1, outs, 0.0),
+                            "pipeline")
+
+    # shard (V, PP, H, H) over the pipeline axis (dim 1)
+    outs = jax.jit(jax.shard_map(
+        f, mesh=parallel_state.get_mesh(),
+        in_specs=(P(None, "pipeline"), P()), out_specs=P()))(ws, xs)
+
+    ref = _sequential_ref(ws, xs)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("remat", [True, False])
+def test_interleaved_fwd_bwd_matches_unpipelined(remat):
+    ws = _chunk_weights()
+    xs = _batches()
+    ts = jnp.asarray(
+        np.random.RandomState(2).randn(M, MB, H).astype("float32"))
+
+    def f(w_local, xs, ts):
+        w = w_local.reshape(V, H, H)
+
+        def loss_fn(out, mb_idx):
+            t = jax.lax.dynamic_index_in_dim(ts, mb_idx, keepdims=False)
+            return jnp.mean((out - t) ** 2)
+
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            _stage_fn, xs, w, num_microbatches=M, loss_fn=loss_fn,
+            remat=remat,
+        )
+        return loss, grads[:, None]
+
+    loss, grads = jax.jit(jax.shard_map(
+        f, mesh=parallel_state.get_mesh(),
+        in_specs=(P(None, "pipeline"), P(), P()),
+        out_specs=(P(), P(None, "pipeline"))))(ws, xs, ts)
+
+    def ref_loss(ws):
+        h = _sequential_ref(ws, xs)
+        return jnp.mean(jax.vmap(
+            lambda o, t: jnp.mean((o - t) ** 2))(h, ts))
+
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(ws)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_validates_divisibility():
+    ws = _chunk_weights()
+
+    def f(w_local, xs):
+        w = w_local.reshape(V, H, H)
+        return spmd_pipeline_interleaved(
+            _stage_fn, w, xs, num_microbatches=6, num_model_chunks=V)
+
+    with pytest.raises(ValueError):
+        jax.jit(jax.shard_map(
+            f, mesh=parallel_state.get_mesh(),
+            in_specs=(P(None, "pipeline"), P()),
+            out_specs=P("pipeline")))(ws, _batches()[:6])
+
+
+def test_get_forward_backward_func_dispatch():
+    assert (get_forward_backward_func()
+            is forward_backward_pipelining_without_interleaving)
+    assert (get_forward_backward_func(1)
+            is forward_backward_pipelining_without_interleaving)
+    assert (get_forward_backward_func(2)
+            is forward_backward_pipelining_with_interleaving)
+
+
+# ------------------------------------------------- microbatch calculators
+
+def test_constant_calculator():
+    c = ConstantNumMicroBatches(64, 2, 4)
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+    c.update(10_000, True)  # no-op
+    assert c.get() == 8
+    with pytest.raises(ValueError):
+        ConstantNumMicroBatches(65, 2, 4)
+
+
+def test_rampup_calculator():
+    # 32 -> 64 in +8 increments over 1000 samples
+    c = RampupBatchsizeNumMicroBatches(32, 8, 1000, 64, 2, 4)
+    assert c.get_current_global_batch_size() == 32
+    assert c.get() == 4
+    c.update(500, False)
+    assert c.get_current_global_batch_size() == 48
+    c.update(2000, False)
+    assert c.get_current_global_batch_size() == 64
+    assert c.get() == 8
+
+
+def test_global_calculator_singleton():
+    destroy_microbatch_calculator()
+    with pytest.raises(RuntimeError):
+        get_num_microbatches()
+    setup_microbatch_calculator(0, None, 64, 2, 4)
+    assert get_num_microbatches() == 8
+    update_num_microbatches(100)
+    assert get_num_microbatches() == 8
+    destroy_microbatch_calculator()
+
+
+def test_build_calculator_rampup_format():
+    with pytest.raises(ValueError):
+        build_num_microbatches_calculator(0, [32, 8], 64, 2, 4)
+    c = build_num_microbatches_calculator(0, [32, 8, 1000], 64, 2, 4)
+    assert isinstance(c, RampupBatchsizeNumMicroBatches)
