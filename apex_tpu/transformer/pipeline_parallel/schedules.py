@@ -187,6 +187,24 @@ def spmd_pipeline(
     return outputs
 
 
+def _pipelined_loss_and_grad(pipeline_call, batch, stage_params, *,
+                             num_microbatches, loss_fn, axis):
+    """Shared loss/grad wrapper for both schedules: per-microbatch loss on
+    the last stage, mean over microbatches, psum-broadcast, value_and_grad
+    through the scan (AD gives the reverse schedule)."""
+    pp = parallel_state.get_pipeline_model_parallel_world_size()
+
+    def pipeline_loss(params):
+        outs = pipeline_call(params)
+        per_mb = jax.vmap(loss_fn)(outs, jnp.arange(num_microbatches))
+        local = jnp.mean(per_mb)
+        stage = jax.lax.axis_index(axis)
+        # only the last stage's loss is real; zero others then sum
+        return jax.lax.psum(jnp.where(stage == pp - 1, local, 0.0), axis)
+
+    return jax.value_and_grad(pipeline_loss)(stage_params)
+
+
 def forward_backward_pipelining_without_interleaving(
     forward_step_fn: Callable,
     batch,
@@ -213,21 +231,12 @@ def forward_backward_pipelining_without_interleaving(
       leaves grads in ``param.grad`` similarly.
     """
     axis = axis_name or _axis()
-    pp = parallel_state.get_pipeline_model_parallel_world_size()
-
-    def pipeline_loss(params):
-        outs = spmd_pipeline(
+    return _pipelined_loss_and_grad(
+        lambda params: spmd_pipeline(
             forward_step_fn, params, batch,
-            num_microbatches=num_microbatches, remat=remat, axis_name=axis,
-        )
-        per_mb = jax.vmap(loss_fn)(outs, jnp.arange(num_microbatches))
-        local = jnp.mean(per_mb)
-        stage = jax.lax.axis_index(axis)
-        # only the last stage's loss is real; zero others then sum
-        return jax.lax.psum(jnp.where(stage == pp - 1, local, 0.0), axis)
-
-    loss, grads = jax.value_and_grad(pipeline_loss)(stage_params)
-    return loss, grads
+            num_microbatches=num_microbatches, remat=remat, axis_name=axis),
+        batch, stage_params, num_microbatches=num_microbatches,
+        loss_fn=loss_fn, axis=axis)
 
 
 def spmd_pipeline_interleaved(
@@ -360,23 +369,15 @@ def forward_backward_pipelining_with_interleaving(
     scan produces the reverse interleaved schedule.
     """
     axis = axis_name or _axis()
-    pp = parallel_state.get_pipeline_model_parallel_world_size()
     if num_model_chunks is None:
         num_model_chunks = jax.tree.leaves(stage_params)[0].shape[0]
-
-    def pipeline_loss(params):
-        outs = spmd_pipeline_interleaved(
+    return _pipelined_loss_and_grad(
+        lambda params: spmd_pipeline_interleaved(
             forward_step_fn, params, batch,
             num_microbatches=num_microbatches,
-            num_model_chunks=num_model_chunks, remat=remat, axis_name=axis,
-        )
-        per_mb = jax.vmap(loss_fn)(outs, jnp.arange(num_microbatches))
-        local = jnp.mean(per_mb)
-        stage = jax.lax.axis_index(axis)
-        return jax.lax.psum(jnp.where(stage == pp - 1, local, 0.0), axis)
-
-    loss, grads = jax.value_and_grad(pipeline_loss)(stage_params)
-    return loss, grads
+            num_model_chunks=num_model_chunks, remat=remat, axis_name=axis),
+        batch, stage_params, num_microbatches=num_microbatches,
+        loss_fn=loss_fn, axis=axis)
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,

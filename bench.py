@@ -8,10 +8,13 @@ softmax, fp32 params, same LAMB math) — i.e. the speedup this framework's
 mixed-precision + fused-kernel path delivers over the naive one, which is
 exactly the value apex adds over eager torch.
 
-Prints ONE JSON line:
-  {"metric": "bert_large_pretrain_samples_per_sec_per_chip",
+Prints ONE JSON line (on TPU — the BASELINE seq-512-class shape):
+  {"metric": "bert_large_pretrain_s512_samples_per_sec_per_chip",
    "value": <optimized samples/sec/chip>, "unit": "samples/sec",
    "vs_baseline": <optimized / fp32-unfused>}
+Off-TPU the flow runs as a tiny-model smoke and the metric is named
+"bert_tiny_smoke_samples_per_sec" so nothing records it as a real
+bert-large number.
 """
 
 import json
@@ -28,8 +31,9 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
     from apex_tpu.models import BertConfig, BertForPreTraining, pretraining_loss
     from apex_tpu.optimizers import FusedLAMB
 
-    cfg = BertConfig.bert_large(
-        hidden_dropout=0.0, attention_dropout=0.0, **cfg_kwargs)
+    maker = (BertConfig.bert_large if jax.default_backend() == "tpu"
+             else BertConfig.tiny)  # off-TPU smoke: shape-check the flow
+    cfg = maker(hidden_dropout=0.0, attention_dropout=0.0, **cfg_kwargs)
     model = BertForPreTraining(cfg)
 
     rng = np.random.RandomState(0)
@@ -66,9 +70,12 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
             return p2, ost2, handle.scalers[0].update(sst, found), loss
 
     # NOTE: no donate_argnums — buffer donation triggers a runtime
-    # INVALID_ARGUMENT on the axon PJRT backend at any scale (verified in
-    # round 1). Donation would halve optimizer-state peak memory; revisit
-    # when the runtime supports it.
+    # INVALID_ARGUMENT on the axon PJRT backend (re-verified this round:
+    # a trivial donated jit works, but donating ANY of this step's args —
+    # even the 3-scalar scaler state alone — fails at run time, so it is
+    # a runtime limitation, not an aliasing bug here). Donation would
+    # halve optimizer-state peak memory (it is what caps S=512 at B=8);
+    # revisit when the runtime supports it.
     jitted = jax.jit(step)
     model_info = dict(
         n_params=sum(x.size for x in jax.tree.leaves(params)),
@@ -116,41 +123,71 @@ def peak_flops():
     return 197e12  # v5e / v5 lite
 
 
-def main():
-    on_tpu = jax.default_backend() == "tpu"
-    batch, seq = (64, 128) if on_tpu else (2, 32)
+def _reset():
+    """Free the previous config's executables + live buffers: at S=512
+    the fp32 baseline only fits on the 16 GB chip if the optimized
+    config's state is truly gone (no donation on this runtime)."""
+    import gc
 
-    # optimized: bf16 O2 + Pallas kernels
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+
+
+def _measure(batch, seq, iters, with_baseline=True):
+    """(optimized dt, baseline dt or None, mfu) at one shape."""
+    _reset()
     jitted, state, info = build_step(
         dict(dtype=jnp.bfloat16, fused_kernels=True), "O2", batch, seq)
-    dt_opt, loss_opt = time_steps(jitted, state)
+    dt_opt, loss_opt = time_steps(jitted, state, warmup=2, iters=iters)
     del jitted, state
+    _reset()
 
-    # baseline: fp32, stock ops, no amp
-    jitted, state, _ = build_step(
-        dict(dtype=jnp.float32, fused_kernels=False), "O0", batch, seq)
-    dt_base, loss_base = time_steps(jitted, state, warmup=2, iters=4)
-    del jitted, state
+    dt_base = loss_base = None
+    if with_baseline:
+        jitted, state, _ = build_step(
+            dict(dtype=jnp.float32, fused_kernels=False), "O0", batch, seq)
+        dt_base, loss_base = time_steps(jitted, state, warmup=2,
+                                        iters=max(iters // 2, 2))
+        del jitted, state
+        _reset()
 
-    samples_per_sec = batch / dt_opt
     mfu = model_flops_per_step(
         info["n_params"], batch, seq, info["n_layers"], info["hidden"],
     ) / dt_opt / peak_flops()
+    base_txt = ("" if dt_base is None else
+                f" | baseline(fp32 unfused) {dt_base*1e3:.1f} ms/step "
+                f"(loss {loss_base:.3f})")
+    print(
+        f"# B={batch} S={seq}: optimized(bf16 O2+fused) "
+        f"{dt_opt*1e3:.1f} ms/step = {batch/dt_opt:.1f} samples/s "
+        f"MFU={mfu:.3f} (loss {loss_opt:.3f}){base_txt} | "
+        f"params={info['n_params']/1e6:.0f}M backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+    return dt_opt, dt_base, mfu
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    # Headline: the BASELINE seq-512-class pretraining shape (B=8 caps the
+    # 16 GB chip while donation is unsupported — see build_step note).
+    batch, seq = (8, 512) if on_tpu else (2, 32)
+    dt_opt, dt_base, mfu = _measure(batch, seq, iters=8)
+    if on_tpu and "--all-shapes" in sys.argv:
+        # secondary shape for comparison with earlier rounds' S=128 runs
+        # (off by default: each extra config costs a slow fresh compile
+        # and the driver runs this file under a time budget)
+        _measure(64, 128, iters=6, with_baseline=False)
+
     result = {
-        "metric": "bert_large_pretrain_samples_per_sec_per_chip",
-        "value": round(samples_per_sec, 3),
+        "metric": ("bert_large_pretrain_s512_samples_per_sec_per_chip"
+                   if on_tpu else "bert_tiny_smoke_samples_per_sec"),
+        "value": round(batch / dt_opt, 3),
         "unit": "samples/sec",
         "vs_baseline": round(dt_base / dt_opt, 3),
     }
     print(json.dumps(result))
-    print(
-        f"# optimized(bf16 O2+fused): {dt_opt*1e3:.1f} ms/step "
-        f"(loss {loss_opt:.3f}) MFU={mfu:.3f} | baseline(fp32 unfused): "
-        f"{dt_base*1e3:.1f} ms/step (loss {loss_base:.3f}) | "
-        f"batch={batch} seq={seq} params={info['n_params']/1e6:.0f}M "
-        f"backend={jax.default_backend()}",
-        file=sys.stderr,
-    )
 
 
 if __name__ == "__main__":

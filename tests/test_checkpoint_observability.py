@@ -89,8 +89,10 @@ def test_metrics_dict():
 
 
 def test_host_overflow_report_prints_contract_line(capsys):
-    from apex_tpu.amp import set_ingraph_logging
+    from apex_tpu.amp import set_ingraph_logging, set_verbosity
 
+    # earlier tests may have initialized amp with verbosity=0
+    set_verbosity(1)
     # simulate a callback-less runtime (axon): host fallback must print
     set_ingraph_logging(False)
     try:
@@ -118,8 +120,9 @@ def test_host_overflow_report_prints_contract_line(capsys):
 def test_no_double_overflow_line_when_ingraph_active(capsys):
     """On callback-capable runtimes the in-graph path prints the line;
     the host fallback must then NOT print it again (grep-and-count)."""
-    from apex_tpu.amp import set_ingraph_logging
+    from apex_tpu.amp import set_ingraph_logging, set_verbosity
 
+    set_verbosity(1)
     set_ingraph_logging(True)
     try:
         scaler = LossScaler("dynamic")
