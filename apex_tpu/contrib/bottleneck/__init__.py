@@ -1,0 +1,10 @@
+"""Contrib bottleneck + spatial halo exchange (reference:
+``apex/contrib/bottleneck``, ``apex/contrib/peer_memory``)."""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (
+    Bottleneck,
+    HaloExchanger1d,
+    SpatialBottleneck,
+)
+
+__all__ = ["Bottleneck", "HaloExchanger1d", "SpatialBottleneck"]

@@ -1,0 +1,140 @@
+"""Fused ResNet bottleneck + spatial-parallel halo exchange (reference:
+``apex/contrib/bottleneck/{bottleneck,halo_exchangers}.py`` +
+``apex/contrib/csrc/{bottleneck,peer_memory,nccl_p2p}/``, SURVEY.md
+§2.3 "spatial parallelism" / §2.5).
+
+Two pieces:
+
+- :class:`Bottleneck`: the 1x1 → 3x3 → 1x1 conv stack with NHWC
+  BatchNorm and the fused residual add+ReLU epilogue
+  (:class:`~apex_tpu.contrib.groupbn.BatchNorm2d_NHWC` with ``z=``).
+  The reference hand-fuses this chain in CUDA; XLA fuses the NHWC
+  conv+BN+ReLU chain natively on TPU.
+
+- :class:`HaloExchanger1d` + :class:`SpatialBottleneck`: spatial
+  parallelism — the image's H dim sharded across devices, with 1-row
+  halos exchanged between neighbors so the 3x3 conv sees its cross-shard
+  receptive field. The reference moves halos over CUDA P2P / NCCL
+  send-recv (``PeerHaloExchanger1d``); on TPU the same exchange is two
+  ``lax.ppermute`` shifts over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+
+def _conv(features, kernel, strides=1, name=None):
+    return nn.Conv(features, (kernel, kernel), strides=(strides, strides),
+                   padding="SAME" if kernel > 1 else "VALID",
+                   use_bias=False, param_dtype=jnp.float32,
+                   kernel_init=nn.initializers.he_normal(), name=name)
+
+
+class Bottleneck(nn.Module):
+    """Reference ``Bottleneck(in_channels, bottleneck_channels,
+    out_channels, stride)`` — NHWC, BN-fused residual add+ReLU."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    use_cudnn: bool = False  # parity knob; ignored (XLA convs)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = _conv(self.bottleneck_channels, 1, name="conv1")(x)
+        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
+                             name="bn1")(y, train=train)
+        y = _conv(self.bottleneck_channels, 3, self.stride,
+                  name="conv2")(y)
+        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
+                             name="bn2")(y, train=train)
+        y = _conv(self.out_channels, 1, name="conv3")(y)
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            residual = _conv(self.out_channels, 1, self.stride,
+                             name="downsample_conv")(x)
+            residual = BatchNorm2d_NHWC(
+                self.out_channels, name="downsample_bn")(
+                residual, train=train)
+        # bn3 with the fused add+relu epilogue (z = residual)
+        return BatchNorm2d_NHWC(self.out_channels, fuse_relu=True,
+                                name="bn3")(y, z=residual, train=train)
+
+
+class HaloExchanger1d:
+    """Exchange ``halo`` rows with ring neighbors along a mesh axis
+    (reference: ``PeerHaloExchanger1d`` over GPU P2P; here ppermute).
+
+    Operates on the H-sharded (N, H_local, W, C) tensor inside
+    ``shard_map``: returns the tensor padded to
+    (N, halo + H_local + halo, W, C) with the neighbors' edge rows (zero
+    at the true image borders — the first/last shard)."""
+
+    def __init__(self, axis_name: str, halo: int = 1):
+        self.axis_name = axis_name
+        self.halo = halo
+
+    def __call__(self, x):
+        axis = self.axis_name
+        n = jax.lax.psum(1, axis)
+        idx = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        bwd = [(i, (i - 1) % n) for i in range(n)]
+        # my bottom rows -> next shard's top halo; my top rows -> prev's
+        bottom = x[:, -self.halo:]
+        top = x[:, :self.halo]
+        from_prev = jax.lax.ppermute(bottom, axis, fwd)
+        from_next = jax.lax.ppermute(top, axis, bwd)
+        # zero halos at the image borders (no wraparound receptive field)
+        from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
+        from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
+                              from_next)
+        return jnp.concatenate([from_prev, x, from_next], axis=1)
+
+
+class SpatialBottleneck(nn.Module):
+    """Reference ``SpatialBottleneck``: the bottleneck with its 3x3 conv
+    computed on H-sharded activations + halo exchange. Run inside
+    ``shard_map`` with ``spatial_axis`` in scope; stride-2 spatial
+    sharding is not supported (the reference's spatial group also only
+    runs stride-1 segments)."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    spatial_axis: str = "spatial"
+    halo: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = _conv(self.bottleneck_channels, 1, name="conv1")(x)
+        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
+                             name="bn1")(y, train=train)
+        # 3x3 with cross-shard receptive field: pad with neighbor halos,
+        # convolve VALID-in-H, trimming the halo contribution exactly
+        exchanger = HaloExchanger1d(self.spatial_axis, self.halo)
+        y = exchanger(y)
+        y = nn.Conv(self.bottleneck_channels, (3, 3), strides=(1, 1),
+                    padding=((0, 0), (1, 1)), use_bias=False,
+                    param_dtype=jnp.float32,
+                    kernel_init=nn.initializers.he_normal(),
+                    name="conv2")(y)
+        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
+                             name="bn2")(y, train=train)
+        y = _conv(self.out_channels, 1, name="conv3")(y)
+        if self.in_channels != self.out_channels:
+            residual = _conv(self.out_channels, 1, name="downsample_conv")(x)
+            residual = BatchNorm2d_NHWC(
+                self.out_channels, name="downsample_bn")(
+                residual, train=train)
+        return BatchNorm2d_NHWC(self.out_channels, fuse_relu=True,
+                                name="bn3")(y, z=residual, train=train)

@@ -1,0 +1,5 @@
+"""Contrib focal_loss (reference: ``apex/contrib/focal_loss``)."""
+
+from apex_tpu.contrib.focal_loss.focal_loss import focal_loss
+
+__all__ = ["focal_loss"]

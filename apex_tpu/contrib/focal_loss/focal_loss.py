@@ -1,0 +1,47 @@
+"""Fused focal loss (reference: ``apex/contrib/focal_loss/focal_loss.py``
++ ``apex/contrib/csrc/focal_loss/``, the retinanet detection kernel;
+SURVEY.md §2.2 contrib misc).
+
+FL(p_t) = -alpha_t * (1 - p_t)^gamma * log(p_t) over one-hot class
+targets, computed from logits in fp32 without materializing softmax
+probabilities separately from the loss (one fused XLA pass; the
+backward comes from autodiff of the same expression).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(logits, targets, alpha: float = 0.25, gamma: float = 2.0,
+               reduction: str = "sum"):
+    """Sigmoid focal loss (the detection formulation the reference
+    implements).
+
+    Args:
+      logits: (..., num_classes) raw scores.
+      targets: (...) int class ids; NEGATIVE ids mean "background /
+        ignore" (contribute only the negative-class term, matching the
+        reference's handling of unmatched anchors).
+      alpha: positive-class weight.
+      gamma: focusing exponent.
+      reduction: "sum" | "mean" | "none".
+    """
+    x = logits.astype(jnp.float32)
+    C = x.shape[-1]
+    t = jax.nn.one_hot(jnp.maximum(targets, 0), C, dtype=jnp.float32)
+    t = jnp.where((targets >= 0)[..., None], t, 0.0)
+
+    p = jax.nn.sigmoid(x)
+    # numerically-stable BCE-with-logits
+    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    loss = a_t * (1 - p_t) ** gamma * ce
+
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    return loss

@@ -1,0 +1,5 @@
+"""Contrib group_norm (reference: ``apex/contrib/group_norm``)."""
+
+from apex_tpu.contrib.group_norm.group_norm import GroupNorm, group_norm_nhwc
+
+__all__ = ["GroupNorm", "group_norm_nhwc"]

@@ -1,0 +1,76 @@
+"""Optimized NHWC GroupNorm (reference: ``apex/contrib/group_norm/`` +
+``apex/contrib/csrc/group_norm/``, SURVEY.md §2.2 — the diffusion-
+workload kernels).
+
+The reference exists because torch's GroupNorm is NCHW and its NHWC CUDA
+path was slow. On TPU, NHWC is the NATIVE conv layout (C on the 128-lane
+minor dim) and XLA fuses the normalize/affine/activation chain into the
+surrounding convs, so the TPU-idiomatic implementation is the jnp
+formula in fp32 over the channels-last tensor — kept as a module for API
+parity, including the reference's optional fused ``act="silu"``/
+``"swish"`` epilogue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_ACTS = {
+    "": lambda x: x,
+    "identity": lambda x: x,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+}
+
+
+def group_norm_nhwc(x, num_groups, weight=None, bias=None, eps=1e-5,
+                    act: str = ""):
+    """Functional NHWC group norm: x is (N, H, W, C) (or (N, ..., C));
+    stats are computed per (N, group) in fp32."""
+    if act not in _ACTS:
+        raise ValueError(f"unsupported act {act!r}; one of {sorted(_ACTS)}")
+    C = x.shape[-1]
+    if C % num_groups:
+        raise ValueError(f"channels ({C}) not divisible by groups "
+                         f"({num_groups})")
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    shape = xf.shape
+    # (N, spatial..., G, C/G) -> normalize over (spatial..., C/G)
+    xg = xf.reshape(shape[0], -1, num_groups, C // num_groups)
+    mean = xg.mean(axis=(1, 3), keepdims=True)
+    var = xg.var(axis=(1, 3), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(shape)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return _ACTS[act](out).astype(orig_dtype)
+
+
+class GroupNorm(nn.Module):
+    """Module parity with the reference's ``GroupNorm(num_groups,
+    num_channels, eps, affine, act)`` (NHWC)."""
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: str = ""
+
+    @nn.compact
+    def __call__(self, x):
+        w = b = None
+        if self.affine:
+            w = self.param("weight", nn.initializers.ones,
+                           (self.num_channels,), jnp.float32)
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.num_channels,), jnp.float32)
+        return group_norm_nhwc(x, self.num_groups, w, b, self.eps, self.act)

@@ -1,0 +1,5 @@
+"""Contrib groupbn / bnp (reference: ``apex/contrib/groupbn``)."""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC
+
+__all__ = ["BatchNorm2d_NHWC"]

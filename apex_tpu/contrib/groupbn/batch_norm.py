@@ -1,0 +1,98 @@
+"""NHWC BatchNorm with fused add+ReLU (reference: ``apex/contrib/
+groupbn/batch_norm.py`` + ``apex/contrib/csrc/groupbn/``, the MLPerf-
+ResNet "bnp" extension; SURVEY.md §2.2/§2.5).
+
+The reference's value is (a) NHWC layout, (b) the fused
+``bn_fused_add_relu`` epilogue (BN + residual add + ReLU in one kernel),
+and (c) cross-GPU "group" BN over small device groups. On TPU: NHWC is
+native, XLA fuses the epilogue chain, and group sync is one Welford
+``psum`` over a mesh axis (subgrouped via ``axis_index_groups`` —
+the same machinery as :mod:`apex_tpu.parallel.sync_batchnorm`).
+
+Functional state (running stats are carried, not mutated)::
+
+    bn = BatchNorm2d_NHWC(64, fuse_relu=True)
+    variables = bn.init(key, x, train=False)
+    y, new_state = bn.apply(variables, x, z=residual, train=True,
+                            mutable=["batch_stats"])
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """Reference class name. ``bn_group``/``axis_name`` enable cross-
+    replica stats over contiguous subgroups of ``bn_group`` devices on
+    the mesh axis (the bnp multi-GPU group).
+
+    ``momentum`` follows the torch/reference convention:
+    ``running = (1 - momentum) * running + momentum * batch`` (default
+    0.1) — call sites ported from apex keep their semantics."""
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, z=None, train: bool = True):
+        """x: (N, H, W, C); z: optional residual (the fused add input)."""
+        C = self.num_features
+        w = self.param("weight", nn.initializers.ones, (C,), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (C,), jnp.float32)
+        running_mean = self.variable(
+            "batch_stats", "running_mean",
+            lambda: jnp.zeros((C,), jnp.float32))
+        running_var = self.variable(
+            "batch_stats", "running_var",
+            lambda: jnp.ones((C,), jnp.float32))
+
+        xf = x.astype(jnp.float32)
+        if train:
+            mean = xf.mean(axis=(0, 1, 2))
+            var = xf.var(axis=(0, 1, 2))
+            if self.bn_group > 1 and self.axis_name is not None:
+                # combine (mean, mean_sq) within each bn_group-sized
+                # subgroup of the axis (reference: the bnp device group)
+                from apex_tpu.utils.collectives import psum_groups
+
+                world = jax.lax.psum(1, self.axis_name)
+                world = int(world) if not hasattr(world, "aval") else None
+                if world is None:
+                    raise RuntimeError(
+                        "bn_group sync requires a static axis size")
+                if world % self.bn_group:
+                    raise ValueError(
+                        f"axis size ({world}) not divisible by bn_group "
+                        f"({self.bn_group})")
+                groups = [list(range(g * self.bn_group,
+                                     (g + 1) * self.bn_group))
+                          for g in range(world // self.bn_group)]
+                mean_sq = var + mean * mean
+                mean = psum_groups(mean, self.axis_name,
+                                   groups) / self.bn_group
+                mean_sq = psum_groups(mean_sq, self.axis_name,
+                                      groups) / self.bn_group
+                var = mean_sq - mean * mean
+            if not self.is_initializing():
+                m = self.momentum  # torch convention: weight on the batch
+                running_mean.value = ((1 - m) * running_mean.value
+                                      + m * mean)
+                running_var.value = (1 - m) * running_var.value + m * var
+        else:
+            mean, var = running_mean.value, running_var.value
+
+        out = (xf - mean) * jax.lax.rsqrt(var + self.eps) * w + b
+        if z is not None:
+            out = out + z.astype(jnp.float32)  # bn_fused_add_(relu)
+        if self.fuse_relu:
+            out = jax.nn.relu(out)
+        return out.astype(x.dtype)

@@ -1,0 +1,17 @@
+"""Fused gather-multiply (reference: ``apex/contrib/index_mul_2d/`` +
+``apex/contrib/csrc/index_mul_2d/``, SURVEY.md §2.2 contrib misc —
+an openfold hot op).
+
+``out[i] = in1[idx[i]] * in2[i]``: the reference fuses the gather and
+multiply to avoid a materialized gathered copy; XLA performs the same
+fusion on ``in1[idx] * in2``, so this is API parity with the gradient
+handled by autodiff (scatter-add into ``in1``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx):
+    """in1: (N, D); in2: (M, D); idx: (M,) int into in1. Returns (M, D)."""
+    return in1[idx] * in2

@@ -1,0 +1,8 @@
+"""Contrib multihead_attn (reference: ``apex/contrib/multihead_attn``)."""
+
+from apex_tpu.contrib.multihead_attn.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+
+__all__ = ["EncdecMultiheadAttn", "SelfMultiheadAttn"]

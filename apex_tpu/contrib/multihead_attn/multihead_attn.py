@@ -1,0 +1,151 @@
+"""Fused multi-head attention modules (reference:
+``apex/contrib/multihead_attn/*.py`` + ``apex/contrib/csrc/
+multihead_attn/``, SURVEY.md §2.2/§2.5).
+
+The reference fuses QKV GEMMs + softmax + dropout + output projection in
+hand-written CUDA, in four variants: self/encdec attention, each with an
+optional pre-LayerNorm + residual-add ("norm_add"). Here the projection
+GEMMs are XLA (MXU, fp32 accumulation), the attention core is the Pallas
+flash kernel (``apex_tpu.ops.flash_attention`` — no (B,H,S,S) tensor),
+and norm_add uses the Pallas FusedLayerNorm.
+
+Layout: inputs are ``(T, B, H)`` sequence-first, the reference's
+convention (torch ``MultiheadAttention`` compatible). ``key_padding_mask``
+is ``(B, S_k)`` boolean, True = masked.
+
+Attention-probability dropout falls back to the composed path (the flash
+kernel does not fuse dropout — same policy as the reference's fmha tier,
+which targets inference/eval and MLPerf's dropout-free phase).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+
+def _attend(q, k, v, key_mask, dropout_rate, deterministic, rng, scale):
+    """(B, H, S, D) attention via flash when dropout is inactive."""
+    if deterministic or dropout_rate == 0.0:
+        return flash_attention(q, k, v, key_mask, False, scale)
+    # composed path with probability dropout (training-time parity with
+    # the reference's dropout-enabled kernels)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :], -30000.0, s)
+    p = jax.nn.softmax(s, axis=-1)
+    keep = 1.0 - dropout_rate
+    mask = jax.random.bernoulli(rng, keep, p.shape)
+    p = jnp.where(mask, p / keep, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Reference: ``SelfMultiheadAttn(embed_dim, num_heads, dropout,
+    bias, include_norm_add, impl)``."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"  # parity knob; both impls map to the same kernels
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key_padding_mask=None,
+                 is_training: bool = True):
+        if self.embed_dim % self.num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        T, B, H = query.shape
+        hd = H // self.num_heads
+        scale = 1.0 / (hd ** 0.5)
+
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(H, name="lyr_nrm")(query)
+
+        qkv = nn.Dense(3 * H, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       name="qkv_proj")(query)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (T, B, H) -> (B, nh, T, hd)
+            return t.reshape(T, B, self.num_heads, hd).transpose(1, 2, 0, 3)
+
+        rng = (self.make_rng("dropout")
+               if is_training and self.dropout > 0.0 else None)
+        ctx = _attend(heads(q), heads(k), heads(v), key_padding_mask,
+                      self.dropout, not is_training, rng, scale)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(T, B, H)
+
+        out = nn.Dense(H, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       name="out_proj")(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out.astype(residual.dtype)  # preserve the input dtype
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Reference: ``EncdecMultiheadAttn`` — queries from the decoder,
+    keys/values from the encoder memory."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, key_padding_mask=None,
+                 is_training: bool = True):
+        if self.embed_dim % self.num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        Tq, B, H = query.shape
+        Tk = key.shape[0]
+        hd = H // self.num_heads
+        scale = 1.0 / (hd ** 0.5)
+
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(H, name="lyr_nrm")(query)
+
+        q = nn.Dense(H, use_bias=self.bias, param_dtype=self.params_dtype,
+                     kernel_init=nn.initializers.xavier_uniform(),
+                     name="q_proj")(query)
+        kv = nn.Dense(2 * H, use_bias=self.bias,
+                      param_dtype=self.params_dtype,
+                      kernel_init=nn.initializers.xavier_uniform(),
+                      name="kv_proj")(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def heads(t, L):
+            return t.reshape(L, B, self.num_heads, hd).transpose(1, 2, 0, 3)
+
+        rng = (self.make_rng("dropout")
+               if is_training and self.dropout > 0.0 else None)
+        ctx = _attend(heads(q, Tq), heads(k, Tk), heads(v, Tk),
+                      key_padding_mask, self.dropout, not is_training, rng,
+                      scale)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(Tq, B, H)
+
+        out = nn.Dense(H, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       name="out_proj")(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out.astype(residual.dtype)  # preserve the input dtype

@@ -1,0 +1,13 @@
+"""Contrib sparsity / ASP (reference: ``apex/contrib/sparsity``)."""
+
+from apex_tpu.contrib.sparsity.asp import (
+    ASP,
+    MaskedOptimizer,
+    apply_masks,
+    compute_sparse_masks,
+    m4n2_1d_mask,
+    sparsity_ratio,
+)
+
+__all__ = ["ASP", "MaskedOptimizer", "apply_masks", "compute_sparse_masks",
+           "m4n2_1d_mask", "sparsity_ratio"]

@@ -1,0 +1,201 @@
+"""ASP — automatic structured (2:4) sparsity (reference:
+``apex/contrib/sparsity/{asp,sparse_masklib}.py``, SURVEY.md §2.5).
+
+The reference computes magnitude-based N:M masks (default ``m4n2_1d``:
+in every group of 4 consecutive weights along the reduction dim, keep
+the 2 largest |w|), multiplies them into the weights, and monkey-patches
+``optimizer.step`` to re-apply masks after every update so pruned slots
+stay zero through training.
+
+Functional TPU form: masks are a pytree computed once
+(:func:`compute_sparse_masks`), applied with :func:`apply_masks`, and
+kept live through training by :class:`MaskedOptimizer` (the
+``init_optimizer_for_pruning`` analog — wraps any
+``apex_tpu.optimizers`` fused optimizer and re-masks params AND fp32
+masters after each step). The mask math itself is one fused
+reshape/top-2 pass per weight; XLA compiles it into a handful of
+elementwise ops (no sort).
+
+The permutation-search accuracy refinement
+(``permutation_search_kernels``) is not ported: it is an offline
+preprocessing heuristic, orthogonal to the training data flow.
+
+Note on layout: weights here are ``(in, out)`` (JAX convention; torch is
+``(out, in)``), so groups run along axis 0 — the contraction dim, which
+is what 2:4 sparse matrix units consume in both layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def m4n2_1d_mask(w) -> jnp.ndarray:
+    """Boolean keep-mask: 2 largest |w| in each group of 4 along axis 0.
+    (Reference ``mask_calculator="m4n2_1d"``.)"""
+    if w.shape[0] % 4:
+        raise ValueError(f"axis 0 ({w.shape[0]}) not divisible by 4")
+    flat = jnp.abs(w.astype(jnp.float32)).reshape(w.shape[0] // 4, 4, -1)
+    # rank within each group of 4 without a sort: count strictly-greater
+    # entries (ties broken by index so exactly 2 survive)
+    a = flat[:, :, None, :]
+    b = flat[:, None, :, :]
+    idx = jnp.arange(4)
+    tie = (a == b) & (idx[None, :, None, None] > idx[None, None, :, None])
+    greater = (b > a) | tie
+    rank = greater.sum(axis=2)  # 0 = largest
+    keep = rank < 2
+    return keep.reshape(w.shape)
+
+
+_CALCULATORS = {"m4n2_1d": m4n2_1d_mask}
+
+
+def _eligible(path_name: str, leaf, allowed_layer_names,
+              disallowed_layer_names) -> bool:
+    if leaf.ndim != 2 or leaf.shape[0] % 4:
+        return False
+    if allowed_layer_names is not None:
+        return any(n in path_name for n in allowed_layer_names)
+    return not any(n in path_name for n in disallowed_layer_names)
+
+
+def compute_sparse_masks(params, mask_calculator: str = "m4n2_1d",
+                         allowed_layer_names=None,
+                         disallowed_layer_names=("embedding", "norm",
+                                                 "bias")):
+    """Mask pytree: a boolean keep-mask for every eligible 2-D weight,
+    ``None`` elsewhere (embeddings/norms/biases by default, mirroring the
+    reference's module-type allowlist)."""
+    calc = _CALCULATORS[mask_calculator]
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree.structure(params)
+    masks = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path).lower()
+        masks.append(calc(leaf)
+                     if _eligible(name, leaf, allowed_layer_names,
+                                  disallowed_layer_names) else None)
+    return jax.tree.unflatten(treedef, [m if m is not None else _NoMask()
+                                        for m in masks])
+
+
+class _NoMask:
+    """Sentinel leaf meaning "leave this parameter dense"."""
+
+    def __repr__(self):
+        return "NoMask"
+
+
+jax.tree_util.register_pytree_node(
+    _NoMask, lambda n: ((), None), lambda aux, ch: _NoMask())
+
+
+def apply_masks(params, masks):
+    """Zero the pruned slots (reference: in-place ``weight.data *=
+    mask``; functional here)."""
+    def mask_one(p, m):
+        if isinstance(m, _NoMask) or m is None:
+            return p
+        return (p * m.astype(p.dtype))
+
+    return jax.tree.map(mask_one, params, masks,
+                        is_leaf=lambda x: isinstance(x, _NoMask))
+
+
+def sparsity_ratio(params, masks) -> float:
+    """Fraction of weights pruned across masked leaves (diagnostics)."""
+    pruned = total = 0
+    for p, m in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(masks,
+                                    is_leaf=lambda x: isinstance(x, _NoMask))):
+        if isinstance(m, _NoMask):
+            continue
+        pruned += int(jnp.sum(~m))
+        total += m.size
+    return pruned / total if total else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MaskedOptimizer:
+    """Reference ``ASP.init_optimizer_for_pruning``: after every inner
+    step, re-apply the masks to params (and the fp32 master copies, so
+    pruned slots cannot drift back through the master path)."""
+
+    inner: Any
+    masks: Any
+
+    def init(self, params):
+        return self.inner.init(apply_masks(params, self.masks))
+
+    def step(self, grads, state, params, skip_if=None, lr=None):
+        new_params, new_state = self.inner.step(
+            grads, state, params, skip_if=skip_if, lr=lr)
+        new_params = apply_masks(new_params, self.masks)
+        if getattr(new_state, "master", None) is not None:
+            new_state = new_state._replace(
+                master=apply_masks(new_state.master, self.masks))
+        return new_params, new_state
+
+
+class ASP:
+    """Class-method veneer matching the reference call sites::
+
+        ASP.init_model_for_pruning(params)   # -> (masked_params, masks)
+        opt = ASP.init_optimizer_for_pruning(opt)
+        ASP.compute_sparse_masks()           # recompute + re-apply
+    """
+
+    _masks = None
+    _params = None
+    _config = None  # (mask_calculator, allowed, disallowed) from init
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator="m4n2_1d",
+                               allowed_layer_names=None,
+                               disallowed_layer_names=("embedding", "norm",
+                                                       "bias")):
+        cls._config = (mask_calculator, allowed_layer_names,
+                       disallowed_layer_names)
+        cls._masks = compute_sparse_masks(
+            params, mask_calculator, allowed_layer_names,
+            disallowed_layer_names)
+        cls._params = apply_masks(params, cls._masks)
+        return cls._params, cls._masks
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer):
+        if cls._masks is None:
+            raise RuntimeError(
+                "call ASP.init_model_for_pruning before "
+                "init_optimizer_for_pruning (reference asserts the same)")
+        return MaskedOptimizer(optimizer, cls._masks)
+
+    @classmethod
+    def compute_sparse_masks(cls, params=None):
+        """Recompute masks with the SAME calculator/name lists given to
+        init_model_for_pruning (the reference's recompute-and-reapply)."""
+        if cls._config is None:
+            raise RuntimeError("call ASP.init_model_for_pruning first")
+        if params is None:
+            params = cls._params
+        calc, allowed, disallowed = cls._config
+        cls._masks = compute_sparse_masks(params, calc, allowed, disallowed)
+        cls._params = apply_masks(params, cls._masks)
+        return cls._params, cls._masks
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return cls._masks is not None
+
+    @classmethod
+    def restore_pruned_weights(cls):
+        """Reference API: forget masks (weights stay as they are; the
+        zeroed slots resume training dense)."""
+        cls._masks = None
+        cls._params = None
+        cls._config = None

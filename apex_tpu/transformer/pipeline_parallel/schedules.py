@@ -187,7 +187,7 @@ def spmd_pipeline(
     return outputs
 
 
-def _pipelined_loss_and_grad(pipeline_call, batch, stage_params, *,
+def _pipelined_loss_and_grad(pipeline_call, stage_params, *,
                              num_microbatches, loss_fn, axis):
     """Shared loss/grad wrapper for both schedules: per-microbatch loss on
     the last stage, mean over microbatches, psum-broadcast, value_and_grad
@@ -235,7 +235,7 @@ def forward_backward_pipelining_without_interleaving(
         lambda params: spmd_pipeline(
             forward_step_fn, params, batch,
             num_microbatches=num_microbatches, remat=remat, axis_name=axis),
-        batch, stage_params, num_microbatches=num_microbatches,
+        stage_params, num_microbatches=num_microbatches,
         loss_fn=loss_fn, axis=axis)
 
 
@@ -376,7 +376,7 @@ def forward_backward_pipelining_with_interleaving(
             forward_step_fn, params, batch,
             num_microbatches=num_microbatches,
             num_model_chunks=num_model_chunks, remat=remat, axis_name=axis),
-        batch, stage_params, num_microbatches=num_microbatches,
+        stage_params, num_microbatches=num_microbatches,
         loss_fn=loss_fn, axis=axis)
 
 

@@ -90,8 +90,10 @@ def test_metrics_dict():
 
 def test_host_overflow_report_prints_contract_line(capsys):
     from apex_tpu.amp import set_ingraph_logging, set_verbosity
+    from apex_tpu.amp._amp_state import get_verbosity
 
     # earlier tests may have initialized amp with verbosity=0
+    prev_verbosity = get_verbosity()
     set_verbosity(1)
     # simulate a callback-less runtime (axon): host fallback must print
     set_ingraph_logging(False)
@@ -115,13 +117,16 @@ def test_host_overflow_report_prints_contract_line(capsys):
         assert not scaler.host_overflow_report(st2, st3)
     finally:
         set_ingraph_logging(None)
+        set_verbosity(prev_verbosity)
 
 
 def test_no_double_overflow_line_when_ingraph_active(capsys):
     """On callback-capable runtimes the in-graph path prints the line;
     the host fallback must then NOT print it again (grep-and-count)."""
     from apex_tpu.amp import set_ingraph_logging, set_verbosity
+    from apex_tpu.amp._amp_state import get_verbosity
 
+    prev_verbosity = get_verbosity()
     set_verbosity(1)
     set_ingraph_logging(True)
     try:
@@ -136,6 +141,7 @@ def test_no_double_overflow_line_when_ingraph_active(capsys):
         assert out.count("Gradient overflow.") == 1
     finally:
         set_ingraph_logging(None)
+        set_verbosity(prev_verbosity)
 
 
 def test_same_seed_bitwise_determinism():

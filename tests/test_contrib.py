@@ -1,0 +1,356 @@
+"""Contrib-tier tests (upstream analog: ``apex/contrib/test/*`` —
+per-subpackage fused-vs-composed parity; SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.bottleneck import (
+    Bottleneck,
+    HaloExchanger1d,
+    SpatialBottleneck,
+)
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+from apex_tpu.contrib.sparsity import (
+    ASP,
+    MaskedOptimizer,
+    apply_masks,
+    compute_sparse_masks,
+    m4n2_1d_mask,
+    sparsity_ratio,
+)
+
+
+# ------------------------------------------------------ multihead_attn
+
+def _mha_ref(q_in, p, nh, key_mask=None):
+    """Composed reference for SelfMultiheadAttn (no dropout)."""
+    T, B, H = q_in.shape
+    hd = H // nh
+    qkv = q_in @ p["qkv_proj"]["kernel"]
+    q, k, v = np.split(np.asarray(qkv), 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(T, B, nh, hd).transpose(1, 2, 0, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+    if key_mask is not None:
+        s = np.where(np.asarray(key_mask)[:, None, None, :], -30000.0, s)
+    p_att = np.exp(s - s.max(-1, keepdims=True))
+    p_att = p_att / p_att.sum(-1, keepdims=True)
+    ctx = np.einsum("bhqk,bhkd->bhqd", p_att, v)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(T, B, H)
+    return ctx @ np.asarray(p["out_proj"]["kernel"])
+
+
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_self_multihead_attn_matches_composed(use_mask):
+    T, B, H, nh = 384, 2, 64, 4  # T >= flash path's block tiling
+    attn = SelfMultiheadAttn(H, nh, dropout=0.0)
+    x = jnp.asarray(np.random.RandomState(0).randn(T, B, H)
+                    .astype("float32"))
+    km = (jnp.asarray(np.random.RandomState(1).rand(B, T) < 0.2)
+          if use_mask else None)
+    params = attn.init(jax.random.PRNGKey(0), x, km, False)
+    out = attn.apply(params, x, km, False)
+    ref = _mha_ref(x, params["params"], nh, km)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-4)
+
+
+def test_self_multihead_attn_norm_add_and_dropout_path():
+    T, B, H = 8, 2, 32
+    attn = SelfMultiheadAttn(H, 4, dropout=0.5, include_norm_add=True)
+    x = jnp.ones((T, B, H))
+    params = attn.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, None, True)
+    out = attn.apply(params, x, None, True,
+                     rngs={"dropout": jax.random.PRNGKey(2)})
+    assert out.shape == (T, B, H)
+    assert np.isfinite(np.asarray(out)).all()
+    # eval: deterministic, no dropout rng needed
+    out2 = attn.apply(params, x, None, False)
+    out3 = attn.apply(params, x, None, False)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out3))
+
+
+def test_encdec_multihead_attn_shapes_and_grad():
+    Tq, Tk, B, H = 6, 10, 2, 32
+    attn = EncdecMultiheadAttn(H, 4, dropout=0.0)
+    q = jnp.asarray(np.random.RandomState(0).randn(Tq, B, H).astype("f4"))
+    k = jnp.asarray(np.random.RandomState(1).randn(Tk, B, H).astype("f4"))
+    params = attn.init(jax.random.PRNGKey(0), q, k, None, False)
+    out = attn.apply(params, q, k, None, False)
+    assert out.shape == (Tq, B, H)
+    g = jax.grad(lambda p: jnp.sum(attn.apply(p, q, k, None, False)))(params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+# ---------------------------------------------------------- group_norm
+
+def test_group_norm_matches_composed():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 4, 32)
+                    .astype("float32"))
+    gn = GroupNorm(num_groups=8, num_channels=32, act="silu")
+    params = gn.init(jax.random.PRNGKey(0), x)
+    out = gn.apply(params, x)
+
+    # composed reference via per-group normalize
+    xf = np.asarray(x).reshape(2, -1, 8, 4)
+    mean = xf.mean(axis=(1, 3), keepdims=True)
+    var = xf.var(axis=(1, 3), keepdims=True)
+    ref = ((xf - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 4, 32)
+    ref = ref / (1 + np.exp(-ref))  # silu with weight=1, bias=0
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_group_norm_validation():
+    with pytest.raises(ValueError):
+        group_norm_nhwc(jnp.ones((1, 2, 2, 30)), 8)
+    with pytest.raises(ValueError):
+        group_norm_nhwc(jnp.ones((1, 2, 2, 32)), 8, act="tanh")
+
+
+# ------------------------------------------------------------- groupbn
+
+def test_batch_norm_nhwc_train_eval_and_fused_add_relu():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3, 3, 16)
+                    .astype("float32"))
+    z = jnp.asarray(np.random.RandomState(1).randn(4, 3, 3, 16)
+                    .astype("float32"))
+    bn = BatchNorm2d_NHWC(16, fuse_relu=True)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    out, mutated = bn.apply(variables, x, z=z, train=True,
+                            mutable=["batch_stats"])
+    xf = np.asarray(x)
+    mean = xf.mean(axis=(0, 1, 2))
+    var = xf.var(axis=(0, 1, 2))
+    ref = np.maximum((xf - mean) / np.sqrt(var + 1e-5) + np.asarray(z), 0.0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+    # torch-convention momentum (0.1 weight on the new batch stats)
+    rm = np.asarray(mutated["batch_stats"]["running_mean"])
+    np.testing.assert_allclose(rm, 0.1 * mean, rtol=1e-4, atol=1e-5)
+
+    # eval path uses running stats
+    out_eval = bn.apply(
+        {"params": variables["params"], "batch_stats": mutated["batch_stats"]},
+        x, train=False)
+    assert np.isfinite(np.asarray(out_eval)).all()
+
+
+def test_batch_norm_nhwc_group_sync():
+    """bn_group>1: stats combine across the mesh axis exactly like
+    computing them on the concatenated batch."""
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 2, 2, 4)
+                    .astype("float32"))
+    bn = BatchNorm2d_NHWC(4, bn_group=8, axis_name="data", momentum=1.0)
+    mesh = jax.make_mesh((8,), ("data",))
+    # init outside shard_map: train=False avoids the group pmean
+    variables = bn.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    def f(x_local):
+        out, mut = bn.apply(variables, x_local, train=True,
+                            mutable=["batch_stats"])
+        return out, mut["batch_stats"]["running_mean"]
+
+    out, means = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=(P("data"), P("data"))))(x)
+    # every shard saw the same (global) mean -> momentum 0 writes it
+    global_mean = np.asarray(x).mean(axis=(0, 1, 2))
+    np.testing.assert_allclose(np.asarray(means).reshape(8, 4)[0],
+                               global_mean, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_nhwc_subgroup_sync():
+    """bn_group smaller than the axis: stats combine only within each
+    contiguous group of bn_group devices."""
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 2, 2, 4)
+                    .astype("float32"))
+    bn = BatchNorm2d_NHWC(4, bn_group=4, axis_name="data", momentum=1.0)
+    mesh = jax.make_mesh((8,), ("data",))
+    variables = bn.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    def f(x_local):
+        _, mut = bn.apply(variables, x_local, train=True,
+                          mutable=["batch_stats"])
+        return mut["batch_stats"]["running_mean"][None]
+
+    means = np.asarray(jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=P("data")))(x))
+    first_half = np.asarray(x)[:4].mean(axis=(0, 1, 2))
+    second_half = np.asarray(x)[4:].mean(axis=(0, 1, 2))
+    np.testing.assert_allclose(means[0], first_half, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(means[7], second_half, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(means[0], means[7])
+
+
+# ---------------------------------------------------------- focal_loss
+
+def test_focal_loss_reduces_easy_examples():
+    logits = jnp.asarray([[5.0, -5.0], [0.1, -0.1]])
+    targets = jnp.asarray([0, 0])
+    per = focal_loss(logits, targets, reduction="none")
+    # confident correct example has much smaller loss than uncertain one
+    assert float(per[0].sum()) < float(per[1].sum()) * 0.1
+
+
+def test_focal_loss_gamma_zero_is_weighted_bce():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(6, 4).astype("float32"))
+    targets = jnp.asarray(rng.randint(0, 4, 6))
+    got = focal_loss(logits, targets, alpha=0.5, gamma=0.0)
+    x = np.asarray(logits)
+    t = np.eye(4)[np.asarray(targets)]
+    bce = np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(float(got), 0.5 * bce.sum(), rtol=1e-5)
+
+
+def test_focal_loss_ignore_negative_targets():
+    logits = jnp.zeros((2, 3))
+    l_all = focal_loss(logits, jnp.asarray([-1, -1]))
+    # background-only: positive term absent but negative-class term remains
+    assert float(l_all) > 0
+
+
+# ------------------------------------------------------- index_mul_2d
+
+def test_index_mul_2d_fwd_bwd():
+    in1 = jnp.asarray(np.random.RandomState(0).randn(5, 3).astype("f4"))
+    in2 = jnp.asarray(np.random.RandomState(1).randn(4, 3).astype("f4"))
+    idx = jnp.asarray([0, 2, 2, 4])
+    out = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(in1)[np.asarray(idx)] *
+                               np.asarray(in2), rtol=1e-6)
+    g1 = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+    # row 2 referenced twice -> grads accumulate
+    np.testing.assert_allclose(np.asarray(g1)[2],
+                               np.asarray(in2)[1] + np.asarray(in2)[2],
+                               rtol=1e-6)
+
+
+# ------------------------------------------------------------ sparsity
+
+def test_m4n2_mask_keeps_two_of_four():
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype("f4"))
+    mask = m4n2_1d_mask(w)
+    groups = np.asarray(mask).reshape(4, 4, 8)
+    np.testing.assert_array_equal(groups.sum(axis=1), 2)
+    # the kept entries are the two largest |w| per group
+    wabs = np.abs(np.asarray(w)).reshape(4, 4, 8)
+    for g in range(4):
+        for c in range(8):
+            kept = wabs[g, :, c][groups[g, :, c]]
+            dropped = wabs[g, :, c][~groups[g, :, c]]
+            assert kept.min() >= dropped.max() - 1e-7
+
+
+def test_compute_and_apply_masks_eligibility():
+    params = {
+        "dense": {"kernel": jnp.ones((8, 4)), "bias": jnp.ones((4,))},
+        "embedding": {"table": jnp.ones((8, 4))},
+        "odd": jnp.ones((3, 4)),  # not divisible by 4 -> dense
+    }
+    masks = compute_sparse_masks(params)
+    masked = apply_masks(params, masks)
+    assert sparsity_ratio(params, masks) == 0.5
+    np.testing.assert_array_equal(np.asarray(masked["dense"]["bias"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(masked["embedding"]["table"]),
+                                  1.0)
+    np.testing.assert_array_equal(np.asarray(masked["odd"]), 1.0)
+    assert float(jnp.mean(masked["dense"]["kernel"])) == 0.5
+
+
+def test_masked_optimizer_keeps_slots_pruned():
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(8, 4)
+                               .astype("f4"))}
+    ASP.restore_pruned_weights()
+    masked_params, masks = ASP.init_model_for_pruning(
+        params, disallowed_layer_names=("nothing",))
+    opt = ASP.init_optimizer_for_pruning(FusedAdam(lr=0.1))
+    state = opt.init(masked_params)
+    p = masked_params
+    for i in range(3):
+        grads = {"w": jnp.ones_like(p["w"])}
+        p, state = opt.step(grads, state, p)
+    w = np.asarray(p["w"])
+    keep = np.asarray(masks["w"])
+    assert (w[~keep] == 0).all()          # pruned slots stay zero
+    assert (np.abs(w[keep]) > 0).all()    # live slots trained
+    assert ASP.is_sparsity_enabled()
+    ASP.restore_pruned_weights()
+    assert not ASP.is_sparsity_enabled()
+
+
+# ---------------------------------------------------------- bottleneck
+
+def test_bottleneck_shapes_and_residual():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 16)
+                    .astype("float32"))
+    blk = Bottleneck(16, 8, 16)
+    variables = blk.init(jax.random.PRNGKey(0), x)
+    out, _ = blk.apply(variables, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 8, 8, 16)
+    assert (np.asarray(out) >= 0).all()  # final fused relu
+
+    blk2 = Bottleneck(16, 8, 32, stride=2)
+    v2 = blk2.init(jax.random.PRNGKey(0), x)
+    out2, _ = blk2.apply(v2, x, train=True, mutable=["batch_stats"])
+    assert out2.shape == (2, 4, 4, 32)
+
+
+def test_halo_exchange_matches_full_conv():
+    """Spatially-sharded 3x3 conv with halo exchange == full-image conv."""
+    N, H, W, C = 2, 16, 8, 4
+    x = jnp.asarray(np.random.RandomState(0).randn(N, H, W, C)
+                    .astype("float32"))
+    kernel = jnp.asarray(np.random.RandomState(1).randn(3, 3, C, C)
+                         .astype("float32") * 0.2)
+    mesh = jax.make_mesh((8,), ("spatial",))
+
+    def sharded(x_local):
+        padded = HaloExchanger1d("spatial", 1)(x_local)
+        return jax.lax.conv_general_dilated(
+            padded, kernel, (1, 1), ((0, 0), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    out = jax.jit(jax.shard_map(
+        sharded, mesh=mesh, in_specs=P(None, "spatial"),
+        out_specs=P(None, "spatial")))(x)
+
+    ref = jax.lax.conv_general_dilated(
+        x, kernel, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_bottleneck_runs_sharded():
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 16, 4, 8)
+                    .astype("float32"))
+    blk = SpatialBottleneck(8, 4, 8, spatial_axis="spatial")
+    mesh = jax.make_mesh((8,), ("spatial",))
+
+    def init_and_apply(x_local):
+        variables = blk.init(jax.random.PRNGKey(0), x_local, False)
+        out, _ = blk.apply(variables, x_local, train=True,
+                           mutable=["batch_stats"])
+        return out
+
+    out = jax.jit(jax.shard_map(
+        init_and_apply, mesh=mesh, in_specs=P(None, "spatial"),
+        out_specs=P(None, "spatial")))(x)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
