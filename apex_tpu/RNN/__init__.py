@@ -1,0 +1,8 @@
+"""apex.RNN parity surface (reference: ``apex/RNN`` — deprecated
+upstream; kept for surface completeness)."""
+
+from apex_tpu.RNN.cells import GRUCell, LSTMCell, RNNCell
+from apex_tpu.RNN.models import GRU, LSTM, RNN, stackedRNN
+
+__all__ = ["GRU", "GRUCell", "LSTM", "LSTMCell", "RNN", "RNNCell",
+           "stackedRNN"]

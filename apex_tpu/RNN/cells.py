@@ -1,0 +1,90 @@
+"""RNN cells (reference: ``apex/RNN/cells.py`` — the deprecated fused
+LSTM/GRU building blocks, SURVEY.md §2.1).
+
+Standard gate math in fp32 with the reference's combined-GEMM layout:
+one input projection and one recurrent projection per step, gates split
+from the fused output — the structure the reference's "fused" cells
+exist for, which XLA reproduces by fusing the elementwise gate chain
+into the two GEMMs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _proj(features, name):
+    return nn.Dense(features, param_dtype=jnp.float32,
+                    kernel_init=nn.initializers.lecun_normal(), name=name)
+
+
+class RNNCell(nn.Module):
+    """Elman cell: h' = act(W x + U h + b) (reference ``RNNCell``)."""
+
+    hidden_size: int
+    activation: Callable = jnp.tanh
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        h_new = self.activation(
+            _proj(self.hidden_size, "ih")(x)
+            + _proj(self.hidden_size, "hh")(h))
+        return (h_new,), h_new
+
+    def initialize_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),)
+
+
+class RNNReLUCell(RNNCell):
+    """Elman cell with ReLU (reference ``nonlinearity="relu"``)."""
+
+    activation: Callable = jax.nn.relu
+
+
+class LSTMCell(nn.Module):
+    """Standard LSTM with the i,f,g,o fused-gate layout (reference
+    ``LSTMCell``/``mLSTMRNNCell`` family)."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        gates = (_proj(4 * self.hidden_size, "ih")(x)
+                 + _proj(4 * self.hidden_size, "hh")(h))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    def initialize_carry(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+
+class GRUCell(nn.Module):
+    """Standard GRU, r/z/n gates (reference ``GRUCell``)."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        rz = jax.nn.sigmoid(
+            _proj(2 * self.hidden_size, "ih_rz")(x)
+            + _proj(2 * self.hidden_size, "hh_rz")(h))
+        r, z = jnp.split(rz, 2, axis=-1)
+        n = jnp.tanh(_proj(self.hidden_size, "ih_n")(x)
+                     + r * _proj(self.hidden_size, "hh_n")(h))
+        h_new = (1.0 - z) * n + z * h
+        return (h_new,), h_new
+
+    def initialize_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),)

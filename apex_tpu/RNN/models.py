@@ -1,0 +1,80 @@
+"""Stacked RNN models (reference: ``apex/RNN/{RNNBackend,models}.py``,
+SURVEY.md §2.1 — the deprecated ``apex.RNN`` surface).
+
+``stackedRNN`` drives any cell over the sequence with ``lax.scan``
+(compiler-friendly: one compiled step body, no per-timestep Python) and
+stacks layers with optional dropout between them; the ``RNN``/``LSTM``/
+``GRU`` factories mirror the reference's constructor names.
+
+Layout: ``(T, B, input)`` sequence-first, like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.RNN.cells import GRUCell, LSTMCell, RNNCell, RNNReLUCell
+
+
+class stackedRNN(nn.Module):  # noqa: N801 — reference name
+    """Reference ``RNNBackend.stackedRNN``: layers of one cell type over
+    the sequence, outputs of layer i feeding layer i+1."""
+
+    cell_type: type
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, initial_carries=None,
+                 deterministic: bool = True):
+        """x: (T, B, input). Returns (outputs (T, B, hidden), carries)."""
+        B = x.shape[1]
+        carries_out = []
+        seq = x
+        for layer in range(self.num_layers):
+            # parent=None: an unbound throwaway just for the carry shape
+            carry0 = (initial_carries[layer] if initial_carries is not None
+                      else self.cell_type(self.hidden_size, parent=None)
+                      .initialize_carry(B, x.dtype))
+
+            # scan the cell over time: a single compiled step body
+            scan_cell = nn.scan(
+                self.cell_type,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0, out_axes=0,
+            )(self.hidden_size, name=f"layer_{layer}")
+            carry, outs = scan_cell(carry0, seq)
+            carries_out.append(carry)
+            seq = outs
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                seq = nn.Dropout(self.dropout)(
+                    seq, deterministic=deterministic)
+        return seq, carries_out
+
+
+def RNN(input_size, hidden_size, num_layers=1, dropout=0.0,
+        nonlinearity="tanh"):
+    """Reference factory ``apex.RNN.models.RNN`` (tanh or relu cells)."""
+    cells = {"tanh": RNNCell, "relu": RNNReLUCell}
+    if nonlinearity not in cells:
+        raise ValueError(
+            f"nonlinearity must be 'tanh' or 'relu', got {nonlinearity!r}")
+    return stackedRNN(cells[nonlinearity], input_size, hidden_size,
+                      num_layers, dropout)
+
+
+def LSTM(input_size, hidden_size, num_layers=1, dropout=0.0):
+    """Reference factory ``apex.RNN.models.LSTM``."""
+    return stackedRNN(LSTMCell, input_size, hidden_size, num_layers, dropout)
+
+
+def GRU(input_size, hidden_size, num_layers=1, dropout=0.0):
+    """Reference factory ``apex.RNN.models.GRU``."""
+    return stackedRNN(GRUCell, input_size, hidden_size, num_layers, dropout)

@@ -36,4 +36,6 @@ from apex_tpu import normalization  # noqa: F401
 from apex_tpu import parallel  # noqa: F401
 from apex_tpu import fp16_utils  # noqa: F401
 from apex_tpu import mlp  # noqa: F401
+from apex_tpu import reparameterization  # noqa: F401
+from apex_tpu import RNN  # noqa: F401
 from apex_tpu import fused_dense  # noqa: F401

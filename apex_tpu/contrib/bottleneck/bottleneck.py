@@ -115,6 +115,11 @@ class SpatialBottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.halo != 1:
+            raise ValueError(
+                "SpatialBottleneck supports halo=1 only: the 3x3 conv's "
+                "valid-in-H geometry consumes exactly one halo row per "
+                "side (use HaloExchanger1d directly for wider halos)")
         residual = x
         y = _conv(self.bottleneck_channels, 1, name="conv1")(x)
         y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,

@@ -28,6 +28,9 @@ def focal_loss(logits, targets, alpha: float = 0.25, gamma: float = 2.0,
       gamma: focusing exponent.
       reduction: "sum" | "mean" | "none".
     """
+    if reduction not in ("sum", "mean", "none"):
+        raise ValueError(
+            f"reduction must be 'sum', 'mean', or 'none', got {reduction!r}")
     x = logits.astype(jnp.float32)
     C = x.shape[-1]
     t = jax.nn.one_hot(jnp.maximum(targets, 0), C, dtype=jnp.float32)

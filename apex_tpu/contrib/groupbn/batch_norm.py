@@ -84,9 +84,14 @@ class BatchNorm2d_NHWC(nn.Module):
                 var = mean_sq - mean * mean
             if not self.is_initializing():
                 m = self.momentum  # torch convention: weight on the batch
+                # torch/cudnn store the UNBIASED variance in running stats
+                count = x.shape[0] * x.shape[1] * x.shape[2] * max(
+                    self.bn_group, 1)
+                unbiased = var * (count / max(count - 1, 1))
                 running_mean.value = ((1 - m) * running_mean.value
                                       + m * mean)
-                running_var.value = (1 - m) * running_var.value + m * var
+                running_var.value = ((1 - m) * running_var.value
+                                     + m * unbiased)
         else:
             mean, var = running_mean.value, running_var.value
 

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+from apex_tpu.ops.flash_attention import FILL, flash_attention
 
 
 def _attend(q, k, v, key_mask, dropout_rate, deterministic, rng, scale):
@@ -39,7 +39,7 @@ def _attend(q, k, v, key_mask, dropout_rate, deterministic, rng, scale):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if key_mask is not None:
-        s = jnp.where(key_mask[:, None, None, :], -30000.0, s)
+        s = jnp.where(key_mask[:, None, None, :], FILL, s)
     p = jax.nn.softmax(s, axis=-1)
     keep = 1.0 - dropout_rate
     mask = jax.random.bernoulli(rng, keep, p.shape)

@@ -1,0 +1,14 @@
+"""apex.reparameterization parity surface (reference:
+``apex/reparameterization``)."""
+
+from apex_tpu.reparameterization.reparameterization import (
+    Reparameterization,
+    WeightNorm,
+    apply_weight_norm,
+    compute_weights,
+    remove_weight_norm,
+    weight_norm,
+)
+
+__all__ = ["Reparameterization", "WeightNorm", "apply_weight_norm",
+           "compute_weights", "remove_weight_norm", "weight_norm"]

@@ -1,0 +1,109 @@
+"""Weight-norm reparameterization (reference:
+``apex/reparameterization/{reparameterization,weight_norm}.py``,
+SURVEY.md §2.1 — legacy surface).
+
+The reference rewrites a module's weight as ``w = g * v / ||v||``
+(Salimans & Kingma) by monkey-patching parameters and pre-forward hooks.
+The functional analog operates on param pytrees:
+
+- :func:`apply_weight_norm`: split matching leaves into ``(g, v)`` pairs
+  (``w_g``/``w_v`` naming, like torch's) — train THESE;
+- :func:`compute_weights` (the pre-forward hook analog): rebuild the
+  dense weights from ``(g, v)`` before ``model.apply``;
+- :func:`remove_weight_norm`: collapse back to plain weights.
+
+Gradients flow through ``compute_weights`` by autodiff — the hand-written
+``backward`` of the reference's ``Reparameterization`` is unnecessary.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_G_SUFFIX = "_g"
+_V_SUFFIX = "_v"
+
+
+def _norm_except(v, dim: int):
+    """||v|| reduced over every axis except ``dim`` (torch ``norm_except_dim``)."""
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(v, g, dim: int = 0):
+    """w = g * v / ||v||  with the norm over all axes but ``dim``."""
+    return (g.astype(jnp.float32) * v.astype(jnp.float32)
+            / _norm_except(v, dim)).astype(v.dtype)
+
+
+class Reparameterization:
+    """Reference base-class surface: ``compute_weight`` +
+    ``reparameterize``/``restore`` over one tensor."""
+
+    @staticmethod
+    def reparameterize(w, dim: int = 0):
+        """w -> (g, v): v = w, g = ||w|| (so compute_weight(g, v) == w)."""
+        g = _norm_except(w, dim).astype(w.dtype)
+        return g, w
+
+    @staticmethod
+    def compute_weight(g, v, dim: int = 0):
+        return weight_norm(v, g, dim)
+
+
+class WeightNorm(Reparameterization):
+    """Reference class name (the only concrete Reparameterization)."""
+
+
+def _is_dict(x):
+    return isinstance(x, dict)
+
+
+def apply_weight_norm(params, name: str = "kernel", dim: int = 0):
+    """Split every leaf whose key equals ``name`` into ``name_g``/
+    ``name_v`` throughout the pytree (reference:
+    ``apply_weight_norm(module, name, dim)``). Returns the new pytree."""
+    if not _is_dict(params):
+        return params
+
+    out = {}
+    for key, val in params.items():
+        if key == name and not _is_dict(val):
+            g, v = WeightNorm.reparameterize(val, dim)
+            out[key + _G_SUFFIX] = g
+            out[key + _V_SUFFIX] = v
+        elif _is_dict(val):
+            out[key] = apply_weight_norm(val, name, dim)
+        else:
+            out[key] = val
+    return out
+
+
+def compute_weights(params, name: str = "kernel", dim: int = 0):
+    """Rebuild dense weights from the ``(g, v)`` pairs (the pre-forward
+    hook): feed the result to ``model.apply``. Differentiable — take
+    grads w.r.t. the reparameterized pytree."""
+    if not _is_dict(params):
+        return params
+
+    out = {}
+    for key, val in params.items():
+        if key.endswith(_G_SUFFIX) and key[:-len(_G_SUFFIX)] == name:
+            v = params[name + _V_SUFFIX]
+            out[name] = WeightNorm.compute_weight(val, v, dim)
+        elif key.endswith(_V_SUFFIX) and key[:-len(_V_SUFFIX)] == name:
+            continue  # consumed with its _g partner
+        elif _is_dict(val):
+            out[key] = compute_weights(val, name, dim)
+        else:
+            out[key] = val
+    return out
+
+
+def remove_weight_norm(params, name: str = "kernel", dim: int = 0):
+    """Collapse ``(g, v)`` back into plain weights (reference name)."""
+    return compute_weights(params, name, dim)

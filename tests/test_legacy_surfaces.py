@@ -1,0 +1,161 @@
+"""Legacy-surface tests: apex.reparameterization (weight norm) and
+apex.RNN (upstream analog: their L0 unit tests; SURVEY.md §2.1)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.reparameterization import (
+    apply_weight_norm,
+    compute_weights,
+    remove_weight_norm,
+    weight_norm,
+)
+from apex_tpu.RNN import GRU, LSTM, RNN, GRUCell, LSTMCell, RNNCell
+
+
+# ---------------------------------------------------- reparameterization
+
+def test_weight_norm_roundtrip_identity():
+    """reparameterize then compute_weight reproduces the weight exactly."""
+    params = {"dense": {"kernel": jnp.asarray(
+        np.random.RandomState(0).randn(6, 4).astype("f4")),
+        "bias": jnp.zeros((4,))}}
+    wn = apply_weight_norm(params)
+    assert set(wn["dense"].keys()) == {"kernel_g", "kernel_v", "bias"}
+    back = compute_weights(wn)
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               rtol=1e-6)
+    # remove == compute
+    removed = remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(removed["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               rtol=1e-6)
+
+
+def test_weight_norm_direction_invariance():
+    """Scaling v leaves w unchanged (the property weight norm exists for:
+    g alone controls the magnitude)."""
+    v = jnp.asarray(np.random.RandomState(0).randn(6, 4).astype("f4"))
+    g = jnp.ones((1, 4))
+    w1 = weight_norm(v, g, dim=1)
+    w2 = weight_norm(3.0 * v, g, dim=1)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5)
+    # per-column norms equal g
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(w1), axis=0), 1.0, rtol=1e-5)
+
+
+def test_weight_norm_training_with_model():
+    """Train the (g, v) parameterization end-to-end through a flax model."""
+    model = nn.Dense(1, use_bias=False)
+    x = jnp.asarray(np.random.RandomState(0).randn(32, 8).astype("f4"))
+    y = x @ np.random.RandomState(1).randn(8, 1).astype("f4")
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    wn_params = apply_weight_norm(params)
+
+    def loss_fn(wn):
+        w = compute_weights(wn)
+        return jnp.mean((model.apply({"params": w}, x) - y) ** 2)
+
+    losses = []
+    for _ in range(60):
+        l, g = jax.jit(jax.value_and_grad(loss_fn))(wn_params)
+        wn_params = jax.tree.map(lambda p, gr: p - 0.1 * gr, wn_params, g)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.1
+
+
+# ----------------------------------------------------------------- RNN
+
+def _np_lstm_ref(x, p, H):
+    """Numpy reference for one LSTM layer with the i,f,g,o layout."""
+    T, B, _ = x.shape
+    wih, bih = np.asarray(p["ih"]["kernel"]), np.asarray(p["ih"]["bias"])
+    whh, bhh = np.asarray(p["hh"]["kernel"]), np.asarray(p["hh"]["bias"])
+    h = np.zeros((B, H), "f4")
+    c = np.zeros((B, H), "f4")
+    outs = []
+
+    def sig(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    for t in range(T):
+        gates = x[t] @ wih + bih + h @ whh + bhh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_lstm_matches_numpy_reference():
+    T, B, I, H = 5, 3, 4, 6
+    model = LSTM(I, H)
+    x = jnp.asarray(np.random.RandomState(0).randn(T, B, I).astype("f4"))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    outs, carries = model.apply(variables, x)
+    ref_outs, ref_h, ref_c = _np_lstm_ref(
+        np.asarray(x), variables["params"]["layer_0"], H)
+    np.testing.assert_allclose(np.asarray(outs), ref_outs, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(carries[0][0]), ref_h, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(carries[0][1]), ref_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("factory,cellname", [
+    (RNN, "RNNCell"), (LSTM, "LSTMCell"), (GRU, "GRUCell")])
+def test_stacked_rnn_shapes_and_grads(factory, cellname):
+    T, B, I, H = 4, 2, 3, 5
+    model = factory(I, H, num_layers=2)
+    x = jnp.asarray(np.random.RandomState(0).randn(T, B, I).astype("f4"))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    outs, carries = model.apply(variables, x)
+    assert outs.shape == (T, B, H)
+    assert len(carries) == 2
+    g = jax.grad(lambda v: jnp.sum(model.apply(v, x)[0]))(variables)
+    flat = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in flat)
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in flat)
+
+
+def test_rnn_nonlinearity_wiring():
+    """relu cells produce non-negative outputs; tanh can go negative."""
+    x = jnp.asarray(np.random.RandomState(0).randn(6, 2, 3).astype("f4"))
+    relu_net = RNN(3, 5, nonlinearity="relu")
+    v = relu_net.init(jax.random.PRNGKey(0), x)
+    outs, _ = relu_net.apply(v, x)
+    assert float(jnp.min(outs)) >= 0.0
+    with pytest.raises(ValueError):
+        RNN(3, 5, nonlinearity="selu")
+
+
+def test_rnn_sequence_memory():
+    """An LSTM can carry information across the sequence: output at the
+    last step depends on the first input."""
+    model = LSTM(2, 8)
+    x = jnp.zeros((6, 1, 2))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out_zero, _ = model.apply(variables, x)
+    x2 = x.at[0, 0, 0].set(5.0)
+    out_mod, _ = model.apply(variables, x2)
+    assert float(jnp.max(jnp.abs(out_zero[-1] - out_mod[-1]))) > 1e-4
+
+
+def test_initial_carries_roundtrip():
+    """Feeding the final carries back continues the sequence exactly."""
+    model = GRU(3, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 2, 3).astype("f4"))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    full_out, _ = model.apply(variables, x)
+    first_out, carries = model.apply(variables, x[:4])
+    second_out, _ = model.apply(variables, x[4:],
+                                initial_carries=carries)
+    np.testing.assert_allclose(np.asarray(second_out),
+                               np.asarray(full_out[4:]), rtol=1e-5,
+                               atol=1e-6)
