@@ -1,0 +1,133 @@
+"""Native (C) host-side helpers — the ``apex_C`` analog.
+
+The reference builds ``apex_C`` (``csrc/flatten_unflatten.cpp``) with
+``--cpp_ext``; here ``csrc/flatten_unflatten.c`` is compiled on first
+use with the system C compiler and loaded through ``ctypes`` (this
+toolchain has no pybind11 — SURVEY.md's build-system note). Everything
+degrades to a numpy fallback when no compiler is available, so the
+package never hard-requires the native path.
+
+API (host numpy buffers)::
+
+    flat = flatten([arr0, arr1, ...])          # one contiguous 1-D u8
+    bufs = unflatten(flat, metas)              # list of arrays back
+
+Device-side packing belongs to XLA (``apex_tpu.utils.pytree``); use
+this for host staging: checkpoint assembly, host-side comm buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                    "csrc", "flatten_unflatten.c")
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"apex_tpu_native_{os.getuid()}")
+    os.makedirs(cache, exist_ok=True)
+    # key the cache by source content (mtime lies across checkouts) …
+    import hashlib
+
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:16]
+    lib_path = os.path.join(cache, f"flatten_unflatten-{digest}.so")
+    try:
+        if not os.path.exists(lib_path):
+            # … and build to a temp name + atomic rename so concurrent
+            # processes never dlopen a half-written file
+            fd, tmp_path = tempfile.mkstemp(suffix=".so", dir=cache)
+            os.close(fd)
+            for cc in ("cc", "gcc", "clang"):
+                try:
+                    subprocess.run(
+                        [cc, "-O2", "-shared", "-fPIC", src, "-o", tmp_path],
+                        check=True, capture_output=True, timeout=60)
+                    os.rename(tmp_path, lib_path)
+                    break
+                except (FileNotFoundError, subprocess.CalledProcessError,
+                        subprocess.TimeoutExpired):
+                    continue
+            else:
+                os.unlink(tmp_path)
+                return None
+        lib = ctypes.CDLL(lib_path)
+        lib.apex_flatten.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_size_t, ctypes.c_void_p]
+        lib.apex_unflatten.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def flatten(arrays: Sequence[np.ndarray]):
+    """Pack host arrays into one contiguous byte buffer.
+
+    Returns ``(flat_u8, metas)`` where ``metas`` is the
+    ``(shape, dtype, nbytes)`` list :func:`unflatten` needs.
+    """
+    # record shapes BEFORE ascontiguousarray (it promotes 0-d to 1-d)
+    metas = [(np.asarray(a).shape, np.asarray(a).dtype,
+              np.asarray(a).nbytes) for a in arrays]
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    total = sum(m[2] for m in metas)
+    out = np.empty(total, np.uint8)
+    lib = _build_and_load()
+    if lib is None or not arrays:
+        off = 0
+        for a in arrays:
+            out[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+            off += a.nbytes
+        return out, metas
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+    sizes = (ctypes.c_size_t * n)(*[a.nbytes for a in arrays])
+    lib.apex_flatten(srcs, sizes, n,
+                     out.ctypes.data_as(ctypes.c_void_p))
+    return out, metas
+
+
+def unflatten(flat: np.ndarray, metas) -> List[np.ndarray]:
+    """Inverse of :func:`flatten`."""
+    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    outs = [np.empty(shape, dtype) for shape, dtype, _ in metas]
+    lib = _build_and_load()
+    if lib is None or not outs:
+        off = 0
+        for o, (_, _, nbytes) in zip(outs, metas):
+            # reshape first: 0-d arrays reject dtype-changing views
+            o.reshape(-1).view(np.uint8)[:] = flat[off:off + nbytes]
+            off += nbytes
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(
+        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs])
+    sizes = (ctypes.c_size_t * n)(*[m[2] for m in metas])
+    lib.apex_unflatten(flat.ctypes.data_as(ctypes.c_void_p), dsts, sizes, n)
+    return outs

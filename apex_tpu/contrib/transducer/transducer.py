@@ -1,0 +1,144 @@
+"""RNN-T transducer joint + loss (reference: ``apex/contrib/transducer/
+transducer.py`` + ``apex/contrib/csrc/transducer/``, SURVEY.md §2.2 —
+fused speech-recognition ops).
+
+- :func:`transducer_joint` (reference ``TransducerJoint``): the
+  broadcast add of the encoder (time) and predictor (label) activations
+  with an optional fused ReLU/dropout epilogue — the reference fuses
+  this because eager torch materializes two broadcasts; XLA fuses the
+  add+activation into one pass over the (B, T, U+1, H) lattice.
+
+- :func:`transducer_loss` (reference ``TransducerLoss``): the RNN-T
+  negative log-likelihood via the forward (alpha) recursion over the
+  (T, U) lattice, as a ``lax.scan`` over time with a scan over labels
+  inside — compiler-friendly sequential DP (no data-dependent Python),
+  fp32 log-space. Gradients come from autodiff of the recursion (the
+  reference hand-writes the beta pass; AD derives it).
+
+Layout: ``log_probs`` is (B, T, U+1, V) — T encoder frames, U target
+labels (+1 for the start), V vocab incl. blank.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, relu: bool = False,
+                     dropout_rate: float = 0.0, rng=None):
+    """Broadcast-add joint: f (B, T, H) + g (B, U+1, H) -> (B, T, U+1, H).
+
+    ``f_len``/``g_len`` accepted for call-site parity (packing is an HBM
+    optimization in the reference; XLA keeps the lattice in registers
+    through the fused epilogue, so dense is layout-optimal here).
+    """
+    del f_len, g_len
+    out = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        out = jax.nn.relu(out)
+    if dropout_rate > 0.0:
+        if rng is None:
+            raise ValueError("dropout_rate > 0 requires an rng key")
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(rng, keep, out.shape)
+        out = jnp.where(mask, out / keep, 0.0)
+    return out
+
+
+def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
+    """RNN-T NLL per example (reference ``TransducerLoss``; unreduced,
+    like the CUDA op).
+
+    Args:
+      log_probs: (B, T, U+1, V) log-softmax outputs of the joint.
+      labels: (B, U) int target labels.
+      f_len: (B,) valid encoder frames per example.
+      y_len: (B,) valid label count per example.
+      blank_idx: the blank symbol.
+
+    Returns:
+      (B,) negative log-likelihoods.
+    """
+    B, T, U1, V = log_probs.shape
+    U = U1 - 1
+    lp = log_probs.astype(jnp.float32)
+
+    # blank and emit scores per lattice node
+    blank = lp[:, :, :, blank_idx]                       # (B, T, U+1)
+    emit = jnp.take_along_axis(
+        lp[:, :, :U, :],
+        labels[:, None, :, None].astype(jnp.int32), axis=3
+    )[..., 0]                                            # (B, T, U)
+
+    def time_step(alpha_prev, t):
+        # horizontal move (consume a frame): alpha_prev + blank at t-1
+        from_blank = jnp.where(
+            t == 0,
+            jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, _NEG_INF),
+            alpha_prev + blank[:, jnp.maximum(t - 1, 0), :],
+        )
+
+        # vertical moves within frame t: emit label u-1 at (t, u-1)
+        def label_step(carry, u):
+            prev = carry  # alpha[t, u-1]
+            cur = jnp.logaddexp(
+                from_blank[:, u],
+                prev + emit[:, t, u - 1],
+            )
+            return cur, cur
+
+        a0 = from_blank[:, 0]
+        _, rest = jax.lax.scan(label_step, a0, jnp.arange(1, U1))
+        alpha_t = jnp.concatenate([a0[:, None], rest.T], axis=1)
+        return alpha_t, None
+
+    # per-example termination at (f_len-1, y_len): freeze each example's
+    # alpha once its frames run out, so the final carry holds alpha at
+    # t = f_len-1 regardless of padding
+    def frozen_time_step(alpha_prev, t):
+        alpha_t, _ = time_step(alpha_prev, t)
+        keep = (t < f_len)[:, None]
+        return jnp.where(keep, alpha_t, alpha_prev), None
+
+    alpha0 = jnp.full((B, U1), _NEG_INF)
+    alpha_final, _ = jax.lax.scan(frozen_time_step, alpha0, jnp.arange(T))
+
+    final_alpha = jnp.take_along_axis(
+        alpha_final, y_len[:, None].astype(jnp.int32), axis=1)[:, 0]
+    last_blank = blank[jnp.arange(B),
+                       jnp.maximum(f_len - 1, 0),
+                       y_len]
+    return -(final_alpha + last_blank)
+
+
+class TransducerJoint:
+    """Reference class-shape veneer."""
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: float = 0.0):
+        if pack_output:
+            raise NotImplementedError(
+                "packed output is a CUDA-memory optimization; the XLA "
+                "path keeps the dense lattice (see transducer_joint)")
+        self.relu = relu
+        self.dropout = dropout
+
+    def __call__(self, f, g, f_len=None, g_len=None, rng=None):
+        return transducer_joint(f, g, f_len, g_len, self.relu,
+                                self.dropout, rng)
+
+
+class TransducerLoss:
+    """Reference class-shape veneer."""
+
+    def __init__(self, packed_input: bool = False):
+        if packed_input:
+            raise NotImplementedError("packed input not supported; dense")
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

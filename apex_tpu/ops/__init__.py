@@ -2,7 +2,10 @@
 
 Where the reference has CUDA kernels (``csrc/``), this package has XLA
 flat-buffer fusions (:mod:`apex_tpu.ops.multi_tensor`) and Pallas TPU
-kernels (:mod:`apex_tpu.ops.layer_norm`, :mod:`apex_tpu.ops.softmax`, ...).
+kernels (:mod:`apex_tpu.ops.layer_norm`, :mod:`apex_tpu.ops.softmax`,
+:mod:`apex_tpu.ops.flash_attention`, :mod:`apex_tpu.ops.ring_attention`).
 """
 
 from apex_tpu.ops import multi_tensor  # noqa: F401
+from apex_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from apex_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,162 @@
+"""Ring attention: context-parallel flash attention over ``ppermute``.
+
+Long-context stretch target (SURVEY.md §5 long-context row): the
+reference tops out at Megatron-SP + seq-length-limited fused kernels;
+ring attention shards the SEQUENCE across a mesh axis and never
+materializes more than one (S/cp)-block of keys/values per device —
+sequence length scales linearly with the ring size.
+
+TPU-native design: each device holds its (B, H, S/cp, D) shard of
+q/k/v. A ``lax.scan`` runs ``cp`` steps; at each step the device
+attends its queries against the CURRENT k/v block with the Pallas flash
+kernel (which already returns per-row logsumexp), folds the block's
+contribution into fp32 running (accumulator, lse) via the standard
+log-sum-exp merge, and rotates k/v to the ring neighbor with
+``ppermute`` — compute and the ICI transfer of the NEXT block overlap
+under XLA's latency-hiding scheduler (the Ring Attention overlap,
+scheduled by the compiler instead of by hand).
+
+Causality across blocks uses the block-index relation (full / in-block
+causal / skip via ``lax.switch``); gradients flow by autodiff — the
+reverse of the scan replays the ring in the opposite direction
+(AD of ppermute is the inverse permutation), with ``jax.checkpoint``
+on the per-step body so only O(S/cp) activations persist per step.
+
+Run inside ``shard_map`` with the context axis in scope; sequence
+shards are contiguous: device i holds tokens [i*S/cp, (i+1)*S/cp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import (
+    FILL,
+    _flash_fwd,
+    mha_reference,
+)
+
+
+def _block_attend(q, k, v, key_mask, causal, scale):
+    """(out, lse) for one q-block vs one kv-block; lse is (B, H, 1, Sq)
+    fp32 (the flash kernel's layout), valid on every path."""
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
+    if lse is not None:
+        # the kernel computes lse at the PADDED query width; trim to the
+        # true Sq so the ring merge shapes line up at any S_local
+        lse = lse[..., :q.shape[2]]
+    if lse is None:
+        # composed fallback (CPU-sim under shard_map): recompute lse
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if key_mask is not None:
+            s = jnp.where(key_mask[:, None, None, :], FILL, s)
+        if causal:
+            Sq, Sk = s.shape[-2:]
+            row = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+            s = jnp.where((row >= col)[None, None], s, FILL)
+        lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+    return out.astype(jnp.float32), lse
+
+
+def ring_attention(q, k, v, key_mask=None, causal: bool = False,
+                   scale: float = 1.0, axis_name: str = "context"):
+    """Context-parallel attention over the ring.
+
+    Args:
+      q, k, v: this device's (B, H, S_local, D) sequence shard.
+      key_mask: optional (B, S_local) boolean padding mask for THIS
+        device's keys (True = masked); rotates with k/v.
+      causal: causal attention over GLOBAL positions (contiguous
+        sharding: device i owns tokens [i*S_local, (i+1)*S_local)).
+      scale: softmax temperature.
+      axis_name: the context-parallel mesh axis.
+
+    Returns:
+      (B, H, S_local, D) attention outputs for this device's queries,
+      in q's dtype.
+    """
+    from apex_tpu.utils.collectives import mark_varying
+
+    cp = jax.lax.psum(1, axis_name)
+    my_rank = jax.lax.axis_index(axis_name)
+    B, H, S_local, D = q.shape
+
+    if key_mask is None:
+        key_mask = jnp.zeros((B, S_local), bool)
+
+    # everything the ring touches is device-varying over the context axis
+    # (plus whatever axes q/k/v already vary over)
+    vma = frozenset({axis_name})
+    for ref in (q, k, v):
+        vma |= frozenset(getattr(jax.typeof(ref), "vma", ()))
+    mark = tuple(vma)
+
+    def step_body(q, kv_rank, k_blk, v_blk, mask_blk):
+        if not causal:
+            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale)
+
+        def full(_):
+            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale)
+
+        def diag(_):
+            return _block_attend(q, k_blk, v_blk, mask_blk, True, scale)
+
+        def skip(_):
+            return (mark_varying(
+                jnp.zeros((B, H, S_local, D), jnp.float32), mark),
+                mark_varying(
+                    jnp.full((B, H, 1, S_local), -jnp.inf, jnp.float32),
+                    mark))
+
+        # kv_rank < my_rank: every key precedes every query -> full;
+        # equal: in-block causal; greater: all masked -> skip
+        case = jnp.clip(jnp.sign(kv_rank - my_rank) + 1, 0, 2)
+        return jax.lax.switch(case, [full, diag, skip], None)
+
+    step_body = jax.checkpoint(step_body, static_argnums=())
+
+    def tick(carry, i):
+        acc, lse_acc, k_blk, v_blk, mask_blk = carry
+        kv_rank = (my_rank - i) % cp  # block i arrived from rank my-i
+        out_i, lse_i = step_body(q, kv_rank, k_blk, v_blk, mask_blk)
+
+        # log-sum-exp merge of the block contribution
+        new_lse = jnp.logaddexp(lse_acc, lse_i)
+        # fully-masked rows: keep weights finite (0 contribution)
+        w_old = jnp.where(jnp.isfinite(new_lse),
+                          jnp.exp(lse_acc - new_lse), 0.0)
+        w_new = jnp.where(jnp.isfinite(new_lse),
+                          jnp.exp(lse_i - new_lse), 0.0)
+        acc = acc * w_old[:, :, 0, :, None] + out_i * w_new[:, :, 0, :, None]
+
+        # rotate k/v/mask to the next device for the following step
+        n = jax.lax.psum(1, axis_name)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        return (acc, new_lse, k_blk, v_blk, mask_blk), None
+
+    # the running accumulators become device-varying from step 1 on
+    # (they mix in ppermuted blocks); mark the init to keep the scan
+    # carry type stable under shard_map's vma checking
+    init = (
+        mark_varying(jnp.zeros((B, H, S_local, D), jnp.float32), mark),
+        mark_varying(jnp.full((B, H, 1, S_local), -jnp.inf, jnp.float32),
+                     mark),
+        k, v, key_mask,
+    )
+    (acc, lse, _, _, _), _ = jax.lax.scan(tick, init, jnp.arange(cp))
+    return acc.astype(q.dtype)
+
+
+def ring_attention_reference(q_full, k_full, v_full, key_mask=None,
+                             causal=False, scale=1.0):
+    """Unsharded reference (full attention) for parity tests."""
+    return mha_reference(q_full, k_full, v_full, key_mask, causal, scale)

@@ -1,0 +1,94 @@
+"""Profiling hooks (SURVEY.md §5 tracing row; reference: ``apex.pyprof``
+— deprecated upstream — plus the external torch-profiler workflow its
+users migrated to).
+
+The reference's pyprof parsed nvprof SQLite dumps to attribute kernels
+to model ops. On TPU the equivalent workflow is ``jax.profiler``: traces
+land in TensorBoard/Perfetto with XLA-op attribution built in. This
+module provides the thin, apex-shaped surface:
+
+- :func:`trace`: context manager around ``jax.profiler.trace`` (the
+  ``pyprof.nvtx.init()`` analog: one line around the training loop);
+- :func:`annotate`: named trace region (``torch.cuda.nvtx.range`` /
+  pyprof op-annotation analog) for attributing loop phases;
+- :class:`StepTimer`: host-side per-step wall timing with warmup
+  exclusion and a summary dict — the "per-step timing surface" SURVEY
+  prescribes, usable on runtimes where the full profiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a profiler trace of the enclosed block into ``log_dir``
+    (view with TensorBoard's profile plugin or Perfetto)."""
+    with jax.profiler.trace(log_dir,
+                            create_perfetto_link=create_perfetto_link):
+        yield
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows up on the op timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def start_server(port: int = 9012):
+    """On-demand profiling server (``jax.profiler.start_server``):
+    connect from TensorBoard's capture-profile button."""
+    return jax.profiler.start_server(port)
+
+
+class StepTimer:
+    """Per-step wall-clock timing with device synchronization.
+
+    Usage::
+
+        timer = StepTimer(warmup=2)
+        for batch in data:
+            out = step(...)
+            timer.tick(out)          # blocks on out, records dt
+        print(timer.summary())       # {mean_ms, p50_ms, min_ms, steps}
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._seen = 0
+        self._times = []
+        self._last: Optional[float] = None
+
+    def tick(self, *sync_on):
+        """Record one step boundary; blocks on ``sync_on`` arrays so the
+        measurement covers the device work, not just dispatch."""
+        if sync_on:
+            jax.block_until_ready(sync_on)
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                self._times.append(now - self._last)
+        self._last = now
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {"steps": 0}
+        ts = sorted(self._times)
+        n = len(ts)
+        return {
+            "steps": n,
+            "mean_ms": 1e3 * sum(ts) / n,
+            "p50_ms": 1e3 * ts[n // 2],
+            "min_ms": 1e3 * ts[0],
+            "max_ms": 1e3 * ts[-1],
+        }
+
+    def reset(self):
+        self._seen = 0
+        self._times.clear()
+        self._last = None

@@ -1,0 +1,37 @@
+/* Host-side flatten/unflatten of tensor buffers.
+ *
+ * Native analog of the reference's apex_C extension
+ * (csrc/flatten_unflatten.cpp, SURVEY.md §2.2): packing a list of
+ * tensors into one contiguous buffer and back. On TPU the DEVICE-side
+ * packing is XLA's concatenate (see apex_tpu/utils/pytree.py); this
+ * C path serves the host-side staging users of apex_C had — checkpoint
+ * assembly and host ring buffers — where Python-loop memcpy dominates.
+ *
+ * Exposed via ctypes (no pybind11 in this toolchain): plain C ABI,
+ * pointer arrays built by the Python wrapper in
+ * apex_tpu/_native/__init__.py, which also owns the fallback when no
+ * compiler is present.
+ */
+
+#include <stddef.h>
+#include <string.h>
+
+/* Copy n source buffers (srcs[i], nbytes[i]) back-to-back into dst. */
+void apex_flatten(const void **srcs, const size_t *nbytes, size_t n,
+                  void *dst) {
+    char *out = (char *)dst;
+    for (size_t i = 0; i < n; ++i) {
+        memcpy(out, srcs[i], nbytes[i]);
+        out += nbytes[i];
+    }
+}
+
+/* Split src into n destination buffers of nbytes[i] each. */
+void apex_unflatten(const void *src, void **dsts, const size_t *nbytes,
+                    size_t n) {
+    const char *in = (const char *)src;
+    for (size_t i = 0; i < n; ++i) {
+        memcpy(dsts[i], in, nbytes[i]);
+        in += nbytes[i];
+    }
+}

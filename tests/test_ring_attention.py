@@ -1,0 +1,103 @@
+"""Ring attention (context parallelism) tests on the 8-device CPU mesh:
+sharded ring == full-sequence attention, forward and gradients
+(SURVEY.md §5 long-context stretch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_attention_reference,
+)
+
+CP = 8
+B, H, D = 2, 2, 16
+S = 64  # global sequence; 8 tokens per device
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (B, H, S, D)),
+            jax.random.normal(ks[1], (B, H, S, D)),
+            jax.random.normal(ks[2], (B, H, S, D)))
+
+
+def _run_ring(q, k, v, key_mask=None, causal=False, scale=0.25):
+    mesh = jax.make_mesh((CP,), ("context",))
+
+    def f(q, k, v, km):
+        return ring_attention(q, k, v, km, causal, scale,
+                              axis_name="context")
+
+    km = (jnp.zeros((B, S), bool) if key_mask is None else key_mask)
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, None, "context"), P(None, None, "context"),
+                  P(None, None, "context"), P(None, "context")),
+        out_specs=P(None, None, "context")))(q, k, v, km)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    q, k, v = _qkv()
+    out = _run_ring(q, k, v, causal=causal)
+    ref = ring_attention_reference(q, k, v, None, causal, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_with_padding_mask():
+    q, k, v = _qkv(1)
+    km = jnp.asarray(np.random.RandomState(2).rand(B, S) < 0.25)
+    out = _run_ring(q, k, v, key_mask=km)
+    ref = ring_attention_reference(q, k, v, km, False, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match_full(causal):
+    q, k, v = _qkv(3)
+    mesh = jax.make_mesh((CP,), ("context",))
+    km = jnp.zeros((B, S), bool)
+
+    def ring_loss(q, k, v, km):
+        out = ring_attention(q, k, v, km, causal, 0.25,
+                             axis_name="context")
+        return jax.lax.psum(jnp.sum(jnp.sin(out.astype(jnp.float32))),
+                            "context")
+
+    g = jax.jit(jax.shard_map(
+        jax.grad(ring_loss, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, None, "context"), P(None, None, "context"),
+                  P(None, None, "context"), P(None, "context")),
+        out_specs=(P(None, None, "context"), P(None, None, "context"),
+                   P(None, None, "context"))))(q, k, v, km)
+
+    def ref_loss(q, k, v):
+        out = ring_attention_reference(q, k, v, None, causal, 0.25)
+        return jnp.sum(jnp.sin(out.astype(jnp.float32)))
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_ring_memory_is_blockwise():
+    """The defining property: no device ever sees more than one
+    (S/cp)-block of keys at a time — checked structurally by running a
+    sequence whose FULL score matrix would be big while per-step blocks
+    are tiny (smoke: it executes; the parity tests prove correctness)."""
+    S_big = 256
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, 1, S_big, D))
+    k = jax.random.normal(ks[1], (1, 1, S_big, D))
+    v = jax.random.normal(ks[2], (1, 1, S_big, D))
+    out = _run_ring(q[:, :, :S_big], k, v, key_mask=jnp.zeros((1, S_big),
+                                                              bool))
+    assert out.shape == (1, 1, S_big, D)
+    assert np.isfinite(np.asarray(out)).all()
