@@ -165,14 +165,40 @@ def convert_syncbn_model(module: nn.Module, axis_name: str = "data",
             process_group=process_group,
             channel_last=True,  # flax BatchNorm is feature-last
         )
-    if not dc.is_dataclass(module):
-        return module
-    changes = {}
-    for f in dc.fields(module):
-        try:
-            v = getattr(module, f.name)
-        except AttributeError:
-            continue
-        if isinstance(v, nn.BatchNorm):
-            changes[f.name] = convert_syncbn_model(v, axis_name, process_group)
-    return dc.replace(module, **changes) if changes else module
+    def walk(mod):
+        """Recursively rewrite BatchNorm fields; returns (module, count)."""
+        if isinstance(mod, nn.BatchNorm):
+            return convert_syncbn_model(mod, axis_name, process_group), 1
+        if not dc.is_dataclass(mod) or not isinstance(mod, nn.Module):
+            return mod, 0
+        changes, converted = {}, 0
+        for f in dc.fields(mod):
+            try:
+                v = getattr(mod, f.name)
+            except AttributeError:
+                continue
+            if isinstance(v, nn.Module):
+                new_v, n = walk(v)
+                if n:
+                    changes[f.name] = new_v
+                    converted += n
+        if changes:
+            return dc.replace(mod, **changes), converted
+        return mod, 0
+
+    out, converted = walk(module)
+    if converted == 0:
+        # The torch version walks the whole runtime module tree; this walk
+        # covers (recursively) every submodule held as a dataclass FIELD,
+        # but modules created inside @nn.compact __call__ bodies are
+        # invisible to it — warn instead of silently no-oping (the
+        # reference contract "convert the whole model" did NOT happen).
+        warnings.warn(
+            "convert_syncbn_model found no nn.BatchNorm among this "
+            "module's (recursive) fields. BatchNorms created inside "
+            "@nn.compact __call__ bodies cannot be rewritten this way; "
+            "parameterize the model on its norm class and pass "
+            "SyncBatchNorm instead.",
+            stacklevel=2,
+        )
+    return out

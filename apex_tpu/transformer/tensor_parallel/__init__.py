@@ -25,3 +25,8 @@ from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     model_parallel_key,
     model_parallel_rng_seed,
 )
+from apex_tpu.transformer.tensor_parallel.main_grad import (  # noqa: F401,E402
+    accumulate_main_grads,
+    init_main_grads,
+    reset_main_grads,
+)

@@ -6,9 +6,11 @@ as flax modules holding LOCAL weight shards, for use inside ``shard_map``
 over the ``tensor`` mesh axis. Knob parity: ``gather_output``,
 ``input_is_parallel``, ``skip_bias_add``, ``bias``,
 ``sequence_parallel_enabled``; ``gradient_accumulation_fusion`` is
-accepted and ignored (XLA fuses the wgrad accumulation into the backward
-dot — the very thing ``fused_weight_gradient_mlp_cuda`` exists for,
-SURVEY.md §2.2).
+accepted as documentation (XLA fuses the wgrad accumulation into the
+backward dot — the very thing ``fused_weight_gradient_mlp_cuda`` exists
+for, SURVEY.md §2.2). For cross-microbatch fp32 gradient accumulation
+(the reference's ``main_grad`` buffers) use
+:mod:`apex_tpu.transformer.tensor_parallel.main_grad`.
 
 Weight partitioning matches the reference: ColumnParallelLinear splits the
 output dim, RowParallelLinear the input dim, VocabParallelEmbedding the

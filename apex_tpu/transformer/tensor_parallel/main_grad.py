@@ -1,0 +1,46 @@
+"""fp32 main-gradient accumulation (reference:
+``fused_weight_gradient_mlp_cuda`` + the ``main_grad`` buffers its
+``gradient_accumulation_fusion`` path writes into; SURVEY.md §2.2).
+
+The reference's CUDA wgrad GEMM accumulates directly into an fp32
+``param.main_grad`` buffer so that summing many bf16/fp16 microbatch
+gradients never loses precision to the low-precision format. The
+TPU-native equivalent is a functional fp32 accumulator pytree: the cast
++ add chain fuses into the backward dot's epilogue under XLA — the same
+"wgrad writes fp32" data flow without a custom kernel.
+
+Usage (gradient accumulation over microbatches)::
+
+    main = init_main_grads(params)
+    for micro in microbatches:
+        grads = jax.grad(loss)(params, micro)     # bf16 grads
+        main = accumulate_main_grads(main, grads) # fp32 accumulation
+    params, opt_state = opt.step(main, opt_state, params)
+    main = reset_main_grads(main)
+
+The TP layers' ``gradient_accumulation_fusion`` knob documents this as
+its implementation (``tensor_parallel/layers.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_main_grads(params):
+    """fp32 zero pytree shaped like ``params`` (the ``main_grad``
+    buffers)."""
+    return jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def accumulate_main_grads(main_grads, grads):
+    """main += fp32(grads) — one fused cast+add pass per leaf."""
+    return jax.tree.map(
+        lambda m, g: m + g.astype(jnp.float32), main_grads, grads)
+
+
+def reset_main_grads(main_grads):
+    """Zero the accumulators (reference: ``zero_grad`` on main_grad)."""
+    return jax.tree.map(jnp.zeros_like, main_grads)

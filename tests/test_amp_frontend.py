@@ -201,3 +201,41 @@ def test_handle_value_and_grad_end_to_end():
     (loss, found), grads = h.value_and_grad(loss_fn, st)(params)
     assert not bool(found)
     assert grads["w"].shape == (4, 4)
+
+
+def test_promote_table_matches_jnp_promotion():
+    """The PROMOTE list documents apex's promote-to-widest contract for
+    mixed-dtype binary ops; assert jnp actually implements it for every
+    listed op (otherwise the table is dead documentation)."""
+    import importlib
+
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.lists import PROMOTE
+
+    a16 = jnp.ones((2, 2), jnp.bfloat16)
+    b32 = jnp.ones((2, 2), jnp.float32)
+    for mod_name, fn_name in PROMOTE:
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        out = fn(a16, b32)
+        if out.dtype == jnp.bool_:
+            continue  # comparisons return bool; promotion happened inside
+        assert out.dtype == jnp.float32, (mod_name, fn_name, out.dtype)
+
+
+def test_convert_syncbn_model_warns_on_no_conversion():
+    import warnings
+
+    import flax.linen as nn
+
+    from apex_tpu.parallel import convert_syncbn_model
+
+    class NoBN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        convert_syncbn_model(NoBN())
+        assert any("no nn.BatchNorm among" in str(x.message) for x in w)

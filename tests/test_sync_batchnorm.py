@@ -267,3 +267,30 @@ def test_unbound_axis_warns_and_falls_back_local():
         assert any("not bound" in str(c.message) for c in caught)
     ref = _reference_bn(np.asarray(x))
     np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convert_syncbn_model_recurses_into_submodules():
+    """Nested-but-reachable BatchNorm fields convert (the torch version
+    walks the whole tree; our walk covers recursive dataclass fields)."""
+    import flax.linen as nn
+
+    from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+    class Inner(nn.Module):
+        bn: nn.Module = None
+
+        def setup(self):
+            pass
+
+        def __call__(self, x):
+            return self.bn(x)
+
+    class Outer(nn.Module):
+        inner: nn.Module = None
+
+        def __call__(self, x):
+            return self.inner(x)
+
+    model = Outer(inner=Inner(bn=nn.BatchNorm(use_running_average=False)))
+    converted = convert_syncbn_model(model)
+    assert isinstance(converted.inner.bn, SyncBatchNorm)

@@ -368,3 +368,34 @@ def test_checkpoint_recompute_matches():
     g1 = jax.grad(lambda x: checkpoint(block, x))(x)
     g2 = jax.grad(block)(x)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+
+def test_main_grad_fp32_accumulation_beats_bf16():
+    """The fused_weight_gradient parity property: accumulating many small
+    bf16 microbatch grads into fp32 main_grad keeps precision that pure
+    bf16 accumulation loses."""
+    from apex_tpu.transformer.tensor_parallel import (
+        accumulate_main_grads,
+        init_main_grads,
+        reset_main_grads,
+    )
+
+    params = {"w": jnp.zeros((64,), jnp.bfloat16)}
+    micro_grad = {"w": jnp.full((64,), 1e-3, jnp.bfloat16)}
+    steps = 512
+
+    main = init_main_grads(params)
+    bf16_acc = jnp.zeros((64,), jnp.bfloat16)
+    for _ in range(steps):
+        main = accumulate_main_grads(main, micro_grad)
+        bf16_acc = bf16_acc + micro_grad["w"]
+
+    true_sum = steps * float(jnp.asarray(micro_grad["w"][0], jnp.float32))
+    fp32_err = abs(float(main["w"][0]) - true_sum)
+    bf16_err = abs(float(jnp.asarray(bf16_acc[0], jnp.float32)) - true_sum)
+    assert fp32_err < 1e-3
+    assert bf16_err > 10 * max(fp32_err, 1e-6)  # bf16 visibly degrades
+
+    zeroed = reset_main_grads(main)
+    assert float(jnp.max(jnp.abs(zeroed["w"]))) == 0.0
+    assert zeroed["w"].dtype == jnp.float32
