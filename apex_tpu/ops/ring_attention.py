@@ -94,7 +94,7 @@ def ring_attention(q, k, v, key_mask=None, causal: bool = False,
     # (plus whatever axes q/k/v already vary over)
     vma = frozenset({axis_name})
     for ref in (q, k, v):
-        vma |= frozenset(getattr(jax.typeof(ref), "vma", ()))
+        vma |= frozenset(getattr(jax.typeof(ref), "vma", None) or ())
     mark = tuple(vma)
 
     def step_body(q, kv_rank, k_blk, v_blk, mask_blk):

@@ -166,9 +166,22 @@ def convert_syncbn_model(module: nn.Module, axis_name: str = "data",
             channel_last=True,  # flax BatchNorm is feature-last
         )
     def walk(mod):
-        """Recursively rewrite BatchNorm fields; returns (module, count)."""
+        """Recursively rewrite BatchNorm fields (incl. inside list/tuple/
+        dict containers); returns (module, count)."""
         if isinstance(mod, nn.BatchNorm):
             return convert_syncbn_model(mod, axis_name, process_group), 1
+        if isinstance(mod, (list, tuple)):
+            items = [walk(v) for v in mod]
+            n = sum(c for _, c in items)
+            if n:
+                return type(mod)(v for v, _ in items), n
+            return mod, 0
+        if isinstance(mod, dict):
+            items = {k: walk(v) for k, v in mod.items()}
+            n = sum(c for _, c in items.values())
+            if n:
+                return {k: v for k, (v, _) in items.items()}, n
+            return mod, 0
         if not dc.is_dataclass(mod) or not isinstance(mod, nn.Module):
             return mod, 0
         changes, converted = {}, 0
@@ -177,7 +190,7 @@ def convert_syncbn_model(module: nn.Module, axis_name: str = "data",
                 v = getattr(mod, f.name)
             except AttributeError:
                 continue
-            if isinstance(v, nn.Module):
+            if isinstance(v, (nn.Module, list, tuple, dict)):
                 new_v, n = walk(v)
                 if n:
                     changes[f.name] = new_v

@@ -61,10 +61,7 @@ def _infer_carry_mark(fn, probe_params, microbatches, axis, name):
     from apex_tpu.utils.collectives import mark_varying
 
     mb_shape = microbatches.shape[1:]
-    try:
-        mb_vma = frozenset(jax.typeof(microbatches).vma)
-    except (AttributeError, TypeError):
-        mb_vma = frozenset()
+    mb_vma = frozenset(getattr(jax.typeof(microbatches), "vma", None) or ())
     vma = frozenset({axis}) | mb_vma  # injected microbatches carry their own
     converged = False
     for it in range(4):  # the varying-set only grows and mesh axes are few
@@ -84,7 +81,7 @@ def _infer_carry_mark(fn, probe_params, microbatches, axis, name):
                 "lookup, logit projection) inside the first/last stage's "
                 "fn, gated on axis_index."
             )
-        out_vma = frozenset(getattr(out_spec, "vma", ())) | vma
+        out_vma = frozenset(getattr(out_spec, "vma", None) or ()) | vma
         if out_vma == vma:
             converged = True
             break

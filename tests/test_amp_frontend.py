@@ -221,21 +221,3 @@ def test_promote_table_matches_jnp_promotion():
         if out.dtype == jnp.bool_:
             continue  # comparisons return bool; promotion happened inside
         assert out.dtype == jnp.float32, (mod_name, fn_name, out.dtype)
-
-
-def test_convert_syncbn_model_warns_on_no_conversion():
-    import warnings
-
-    import flax.linen as nn
-
-    from apex_tpu.parallel import convert_syncbn_model
-
-    class NoBN(nn.Module):
-        @nn.compact
-        def __call__(self, x):
-            return x
-
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        convert_syncbn_model(NoBN())
-        assert any("no nn.BatchNorm among" in str(x.message) for x in w)

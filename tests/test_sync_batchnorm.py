@@ -294,3 +294,47 @@ def test_convert_syncbn_model_recurses_into_submodules():
     model = Outer(inner=Inner(bn=nn.BatchNorm(use_running_average=False)))
     converted = convert_syncbn_model(model)
     assert isinstance(converted.inner.bn, SyncBatchNorm)
+
+
+def test_convert_syncbn_model_warns_on_no_conversion():
+    import warnings
+
+    import flax.linen as nn
+
+    from apex_tpu.parallel import convert_syncbn_model
+
+    class NoBN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        convert_syncbn_model(NoBN())
+        assert any("no nn.BatchNorm among" in str(x.message) for x in w)
+
+
+def test_convert_syncbn_model_walks_containers():
+    """BatchNorms inside list/tuple fields of submodules convert too."""
+    import flax.linen as nn
+
+    from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+    class Layer(nn.Module):
+        bn: nn.Module = None
+
+        def __call__(self, x):
+            return self.bn(x)
+
+    class Net(nn.Module):
+        layers: tuple = ()
+
+        def __call__(self, x):
+            for l in self.layers:
+                x = l(x)
+            return x
+
+    model = Net(layers=(Layer(bn=nn.BatchNorm(use_running_average=False)),
+                        Layer(bn=nn.BatchNorm(use_running_average=False))))
+    converted = convert_syncbn_model(model)
+    assert all(isinstance(l.bn, SyncBatchNorm) for l in converted.layers)
