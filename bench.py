@@ -170,11 +170,11 @@ def _measure(batch, seq, iters, with_baseline=True):
 
 def main():
     on_tpu = jax.default_backend() == "tpu"
-    # Headline: the BASELINE seq-512-class pretraining shape. B=16 fits
+    # Headline: the BASELINE seq-512-class pretraining shape. B=32 fits
     # the 16 GB chip since pretraining_loss stopped materializing the
     # fp32 (B,S,V) log-prob tensor; donation (still unsupported — see
     # build_step note) would allow larger.
-    batch, seq = (16, 512) if on_tpu else (2, 32)
+    batch, seq = (32, 512) if on_tpu else (2, 32)
     dt_opt, dt_base, mfu = _measure(batch, seq, iters=8)
     if on_tpu and "--all-shapes" in sys.argv:
         # secondary shape for comparison with earlier rounds' S=128 runs
