@@ -1,0 +1,11 @@
+"""Contrib conv_bias_relu (reference: ``apex/contrib/conv_bias_relu``)."""
+
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+    conv_frozen_scale_bias_relu,
+)
+
+__all__ = ["conv_bias", "conv_bias_mask_relu", "conv_bias_relu",
+           "conv_frozen_scale_bias_relu"]

@@ -1,0 +1,56 @@
+"""Fused conv + bias (+ mask) (+ ReLU) (reference:
+``apex/contrib/conv_bias_relu/`` over cudnn-frontend fusions, SURVEY.md
+§2.2 contrib misc).
+
+The reference exists because eager torch runs conv, bias add, and ReLU
+as separate kernels; its cudnn-graph path fuses them. XLA fuses the
+NHWC conv+bias+activation chain natively on TPU, so these are API-parity
+functionals with fp32 accumulation; gradients by autodiff (the
+reference hand-writes the backward through the cudnn graph).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, weight, stride, padding):
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    return jax.lax.conv_general_dilated(
+        x, weight.astype(x.dtype), stride, padding, dimension_numbers=_DN,
+        preferred_element_type=jnp.float32)
+
+
+def conv_bias(x, weight, bias, stride=1, padding=0):
+    """Reference ``ConvBias``: NHWC conv + bias, fp32 accumulation."""
+    return (_conv(x, weight, stride, padding)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def conv_bias_relu(x, weight, bias, stride=1, padding=0):
+    """Reference ``ConvBiasReLU``: conv + bias + ReLU in one fused pass."""
+    return jax.nn.relu(
+        _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, stride=1, padding=0):
+    """Reference ``ConvBiasMaskReLU``: conv + bias, elementwise mask
+    multiply, then ReLU (the dropout-style mask the cudnn graph fuses)."""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    return jax.nn.relu(y * mask.astype(jnp.float32)).astype(x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, stride=1, padding=0):
+    """Reference ``ConvFrozenScaleBiasReLU``: conv with a frozen-BN
+    affine folded in (y = conv * scale + bias, then ReLU)."""
+    y = _conv(x, weight, stride, padding)
+    return jax.nn.relu(
+        y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    ).astype(x.dtype)

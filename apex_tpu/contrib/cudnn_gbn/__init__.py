@@ -1,0 +1,9 @@
+"""Contrib cudnn_gbn (reference: ``apex/contrib/cudnn_gbn`` — the
+cudnn-frontend group BatchNorm). Same semantics as the bnp groupbn tier:
+NHWC BatchNorm with cross-replica stats over device subgroups, so
+:class:`GroupBatchNorm2d` is the groupbn module under the reference's
+cudnn_gbn class name."""
+
+from apex_tpu.contrib.cudnn_gbn.batch_norm import GroupBatchNorm2d
+
+__all__ = ["GroupBatchNorm2d"]

@@ -1,0 +1,22 @@
+"""GroupBatchNorm2d (reference: ``apex/contrib/cudnn_gbn/batch_norm.py``).
+
+The reference constructor is ``GroupBatchNorm2d(num_features,
+group_size, ...)``; this factory preserves that positional signature
+(a flax dataclass subclass would misbind ``group_size`` into ``eps``)
+and returns the groupbn module that implements the semantics."""
+
+from typing import Optional
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC
+
+
+def GroupBatchNorm2d(num_features: int, group_size: int = 1, *,
+                     eps: float = 1e-5, momentum: float = 0.1,
+                     fuse_relu: bool = False,
+                     axis_name: Optional[str] = None) -> BatchNorm2d_NHWC:
+    """Reference call-site parity: ``GroupBatchNorm2d(C, group)`` →
+    NHWC BatchNorm with cross-replica stats over ``group``-sized device
+    subgroups of ``axis_name``."""
+    return BatchNorm2d_NHWC(
+        num_features=num_features, eps=eps, momentum=momentum,
+        fuse_relu=fuse_relu, bn_group=group_size, axis_name=axis_name)

@@ -45,12 +45,16 @@ from apex_tpu.ops._common import (
 )
 
 
-def _block_rows(n_rows: int) -> int:
-    """Row-block size. Callers pad the row count to a multiple of this, so
-    VMEM usage is bounded at (256, Hpad) tiles regardless of N (a single
-    all-rows tile would blow the ~16 MB VMEM budget for large N)."""
-    if n_rows >= 256:
-        return 256
+def _block_rows(n_rows: int, hpad: int) -> int:
+    """Row-block size, tuned per hidden size (the role of the reference's
+    contrib ``fast_layer_norm`` per-hidden-size kernels): keep the fp32
+    working tile near ~2 MB so VMEM holds the in/out/scratch set at any
+    H — 256 rows up to H=2048, shrinking for wider rows (H=8192 -> 64
+    rows) instead of blowing the ~16 MB budget."""
+    budget_rows = max(2 * 1024 * 1024 // (hpad * 4), 8)
+    cap = min(256, _round_up(budget_rows, 8))
+    if n_rows >= cap:
+        return cap
     return _round_up(max(n_rows, 1), 8)
 
 
@@ -139,7 +143,7 @@ def _bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, eps, true_h, rms
 
 def _pallas_forward(x2, weight, bias, *, eps, true_h, rms):
     n, hpad = x2.shape
-    br = _block_rows(n)
+    br = _block_rows(n, hpad)
     kernel = functools.partial(
         _fwd_kernel_nb if bias is None else _fwd_kernel_b,
         eps=eps, true_h=true_h, rms=rms, padded=(true_h != hpad),
@@ -164,7 +168,7 @@ def _pallas_forward(x2, weight, bias, *, eps, true_h, rms):
 
 def _pallas_backward(g2, x2, weight, *, eps, true_h, rms):
     n, hpad = x2.shape
-    br = _block_rows(n)
+    br = _block_rows(n, hpad)
     grid = n // br
     kernel = functools.partial(
         _bwd_kernel, eps=eps, true_h=true_h, rms=rms, padded=(true_h != hpad),
@@ -207,7 +211,7 @@ def _prep(x, weight, bias):
         n *= d
     x2 = x.reshape(n, h)
     hpad = _round_up(h, LANE)
-    npad = _round_up(n, _block_rows(n))
+    npad = _round_up(n, _block_rows(n, hpad))
     if hpad != h or npad != n:
         x2 = jnp.pad(x2, ((0, npad - n), (0, hpad - h)))
         weight = jnp.pad(weight, (0, hpad - h))

@@ -425,3 +425,54 @@ def test_transducer_joint_broadcast_and_relu():
         rtol=1e-6)
     out_relu = transducer_joint(f, g, relu=True)
     assert float(jnp.min(out_relu)) >= 0.0
+
+
+# ------------------------------------------------- conv_bias_relu / gbn
+
+def test_conv_bias_relu_matches_composed():
+    from apex_tpu.contrib.conv_bias_relu import (
+        conv_bias,
+        conv_bias_mask_relu,
+        conv_bias_relu,
+        conv_frozen_scale_bias_relu,
+    )
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 4).astype("f4"))
+    w = jnp.asarray(rng.randn(3, 3, 4, 6).astype("f4") * 0.2)
+    b = jnp.asarray(rng.randn(6).astype("f4"))
+
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+    np.testing.assert_allclose(np.asarray(conv_bias(x, w, b, padding=1)),
+                               np.asarray(ref), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(conv_bias_relu(x, w, b, padding=1)),
+        np.maximum(np.asarray(ref), 0), rtol=1e-4, atol=1e-5)
+
+    mask = jnp.asarray(rng.rand(2, 8, 8, 6) < 0.5).astype("f4")
+    np.testing.assert_allclose(
+        np.asarray(conv_bias_mask_relu(x, w, b, mask, padding=1)),
+        np.maximum(np.asarray(ref) * np.asarray(mask), 0),
+        rtol=1e-4, atol=1e-5)
+
+    scale = jnp.asarray(rng.rand(6).astype("f4") + 0.5)
+    ref_fs = jax.lax.conv_general_dilated(
+        x, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) * scale + b
+    np.testing.assert_allclose(
+        np.asarray(conv_frozen_scale_bias_relu(x, w, scale, b, padding=1)),
+        np.maximum(np.asarray(ref_fs), 0), rtol=1e-4, atol=1e-5)
+
+
+def test_cudnn_gbn_alias():
+    from apex_tpu.contrib.cudnn_gbn import GroupBatchNorm2d
+
+    # reference positional signature: (num_features, group_size)
+    bn = GroupBatchNorm2d(8, 2, axis_name="data")
+    assert bn.bn_group == 2 and bn.eps == 1e-5
+    x = jnp.ones((2, 3, 3, 8))
+    variables = bn.init(jax.random.PRNGKey(0), x, train=False)
+    out = bn.apply(variables, x, train=False)
+    assert out.shape == x.shape

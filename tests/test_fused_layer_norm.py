@@ -155,7 +155,7 @@ def test_large_prime_row_count_stays_block_tiled():
     blocks (review regression: a (12291, H) single tile would not fit)."""
     from apex_tpu.ops.layer_norm import _block_rows, _round_up
 
-    assert _block_rows(12291) == 256
+    assert _block_rows(12291, 128) == 256
     x = _data((3, 4097, 128))  # 12291 rows
     w = jnp.ones((128,))
     b = jnp.zeros((128,))
@@ -166,3 +166,24 @@ def test_large_prime_row_count_stays_block_tiled():
     # grads through the padded-rows path
     gx = jax.grad(lambda x: jnp.sum(fused_layer_norm_affine(x, w, b)))(x)
     assert bool(jnp.all(jnp.isfinite(gx)))
+
+
+def test_block_rows_shrink_for_wide_hidden():
+    """Per-hidden-size tuning (the fast_layer_norm role): wide rows get
+    smaller blocks so the fp32 tile stays ~2 MB; a regression that
+    ignores hpad passes CPU-interpret tests but OOMs VMEM on hardware."""
+    from apex_tpu.ops.layer_norm import _block_rows
+
+    assert _block_rows(4096, 1024) == 256
+    assert _block_rows(4096, 2048) == 256
+    assert _block_rows(4096, 4096) == 128
+    assert _block_rows(4096, 8192) == 64
+    assert _block_rows(4096, 65536) == 8   # floor
+    # wide-H functional path (interpret on CPU, compiled on TPU)
+    x = _data((64, 8192))
+    w = jnp.ones((8192,))
+    b = jnp.zeros((8192,))
+    y = fused_layer_norm_affine(x, w, b)
+    ref = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
