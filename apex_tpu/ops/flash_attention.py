@@ -356,8 +356,8 @@ def _block_sizes(Sq, Sk):
     return (_block_dim(Sq), _block_dim(Sk))
 
 
-def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0):
-    """Composed-ops reference: materializes (B, H, Sq, Sk) scores."""
+def _scores(q, k, key_mask, causal, scale):
+    """(B, H, Sq, Sk) fp32 masked scores — shared by every composed path."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if key_mask is not None:
@@ -367,7 +367,12 @@ def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0):
         row = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
         s = jnp.where((row >= col)[None, None], s, FILL)
-    p = jax.nn.softmax(s, axis=-1)
+    return s
+
+
+def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0):
+    """Composed-ops reference: materializes (B, H, Sq, Sk) scores."""
+    p = jax.nn.softmax(_scores(q, k, key_mask, causal, scale), axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -409,16 +414,12 @@ def _flash_vjp_fwd(q, k, v, key_mask, causal, scale):
     return out, (q, k, v, key_mask, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, res, g):
-    q, k, v, key_mask, out, lse = res
-    if lse is None:  # jnp fallback path: differentiate the reference
-        def f(q, k, v):
-            return mha_reference(q, k, v, key_mask, causal, scale)
-
-        _, vjp = jax.vjp(f, q, k, v)
-        dq, dk, dv = vjp(g)
-        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v), None)
-
+def _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded, g,
+                g_lse=None):
+    """Shared recompute backward for both vjps. ``lse_padded`` is the
+    kernel's padded-width lse; ``g_lse`` (optional, (B, H, 1, Sq)) is the
+    lse cotangent, folded into delta (d lse/d s = p, so
+    ds = p * (dP - (rowsum(dO*O) - dlse)))."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk = _block_sizes(Sq, Sk)
@@ -435,15 +436,82 @@ def _flash_vjp_bwd(causal, scale, res, g):
     # Mosaic-friendly layout (size-1 block dims must equal array dims).
     delta = jnp.sum(gp.astype(jnp.float32) * outp.astype(jnp.float32),
                     axis=-1)[:, :, None, :]
-    dq, dk, dv = _flash_bwd_call(qp, kp, vp, mask, gp, lse, delta,
+    if g_lse is not None:
+        glp = g_lse
+        if Sqp != Sq:
+            glp = jnp.pad(g_lse, ((0, 0), (0, 0), (0, 0), (0, Sqp - Sq)))
+        delta = delta - glp.astype(jnp.float32)
+    dq, dk, dv = _flash_bwd_call(qp, kp, vp, mask, gp, lse_padded, delta,
                                  scale=scale, causal=causal, bq=bq, bk=bk)
-    dq = dq[:, :, :Sq, :D]
-    dk = dk[:, :, :Sk, :D]
-    dv = dv[:, :, :Sk, :D]
-    return (match_vma(dq.astype(q.dtype), q),
-            match_vma(dk.astype(k.dtype), k),
-            match_vma(dv.astype(v.dtype), v),
+    return (match_vma(dq[:, :, :Sq, :D].astype(q.dtype), q),
+            match_vma(dk[:, :, :Sk, :D].astype(k.dtype), k),
+            match_vma(dv[:, :, :Sk, :D].astype(v.dtype), v),
             None)
 
 
+def _flash_vjp_bwd(causal, scale, res, g):
+    q, k, v, key_mask, out, lse = res
+    if lse is None:  # jnp fallback path: differentiate the reference
+        def f(q, k, v):
+            return mha_reference(q, k, v, key_mask, causal, scale)
+
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(g)
+        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v), None)
+    return _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse, g)
+
+
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) variant for blockwise consumers (ring attention)
+# ---------------------------------------------------------------------------
+
+def _with_lse_reference(q, k, v, key_mask, causal, scale):
+    """Composed (out, lse): the differentiable fallback path."""
+    s = _scores(q, k, key_mask, causal, scale)
+    lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+    p = jnp.exp(s - lse.transpose(0, 1, 3, 2))
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention_with_lse(q, k, v, key_mask=None, causal: bool = False,
+                             scale: float = 1.0):
+    """Flash attention returning ``(out, lse)`` with lse trimmed to the
+    true Sq — the building block for blockwise/ring consumers that merge
+    per-block results via log-sum-exp. Differentiable INCLUDING the lse
+    output: its cotangent folds into the recompute backward's delta
+    (``delta = rowsum(dO*O) - dlse``; d lse/d s = p)."""
+    if use_jnp_fallback(q, k, v, key_mask):
+        return _with_lse_reference(q, k, v, key_mask, causal, scale)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
+    return out, lse[..., :q.shape[2]]
+
+
+def _fwl_fwd(q, k, v, key_mask, causal, scale):
+    if use_jnp_fallback(q, k, v, key_mask):
+        out, lse_t = _with_lse_reference(q, k, v, key_mask, causal, scale)
+        return (out, lse_t), (q, k, v, key_mask, out, None)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
+    return (out, lse[..., :q.shape[2]]), (q, k, v, key_mask, out, lse)
+
+
+def _fwl_bwd(causal, scale, res, cotangents):
+    q, k, v, key_mask, out, lse_padded = res
+    g, g_lse = cotangents
+    if lse_padded is None:  # fallback path: autodiff the composed form
+        def f(q, k, v):
+            return _with_lse_reference(q, k, v, key_mask, causal, scale)
+
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp((g, g_lse))
+        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v), None)
+    return _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded,
+                       g, g_lse)
+
+
+flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)

@@ -35,32 +35,16 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.flash_attention import (
-    FILL,
-    _flash_fwd,
+    flash_attention_with_lse,
     mha_reference,
 )
 
 
 def _block_attend(q, k, v, key_mask, causal, scale):
     """(out, lse) for one q-block vs one kv-block; lse is (B, H, 1, Sq)
-    fp32 (the flash kernel's layout), valid on every path."""
-    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
-    if lse is not None:
-        # the kernel computes lse at the PADDED query width; trim to the
-        # true Sq so the ring merge shapes line up at any S_local
-        lse = lse[..., :q.shape[2]]
-    if lse is None:
-        # composed fallback (CPU-sim under shard_map): recompute lse
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        if key_mask is not None:
-            s = jnp.where(key_mask[:, None, None, :], FILL, s)
-        if causal:
-            Sq, Sk = s.shape[-2:]
-            row = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-            s = jnp.where((row >= col)[None, None], s, FILL)
-        lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+    fp32. Differentiable on both paths — the flash kernel variant folds
+    the lse cotangent into its recompute backward."""
+    out, lse = flash_attention_with_lse(q, k, v, key_mask, causal, scale)
     return out.astype(jnp.float32), lse
 
 
@@ -87,15 +71,19 @@ def ring_attention(q, k, v, key_mask=None, causal: bool = False,
     my_rank = jax.lax.axis_index(axis_name)
     B, H, S_local, D = q.shape
 
-    if key_mask is None:
-        key_mask = jnp.zeros((B, S_local), bool)
-
     # everything the ring touches is device-varying over the context axis
     # (plus whatever axes q/k/v already vary over)
     vma = frozenset({axis_name})
     for ref in (q, k, v):
         vma |= frozenset(getattr(jax.typeof(ref), "vma", None) or ())
     mark = tuple(vma)
+
+    if key_mask is None:
+        key_mask = jnp.zeros((B, S_local), bool)
+    # the mask rotates through ppermute like k/v: its carry slot must be
+    # device-varying even when the caller passed an invariant (or default
+    # all-False) mask
+    key_mask = mark_varying(key_mask, mark)
 
     def step_body(q, kv_rank, k_blk, v_blk, mask_blk):
         if not causal:

@@ -48,3 +48,12 @@ def test_train_bert_tiny(capsys):
                 "--batch-size", "2", "--seq", "64"])
     out = capsys.readouterr().out
     assert "ms/step" in out
+
+
+def test_train_long_context(capsys):
+    from examples.train_long_context import main
+
+    _run(main, ["train_long_context", "--seq", "256", "--steps", "4",
+                "--hidden", "64", "--vocab", "128"])
+    out = capsys.readouterr().out
+    assert "tokens/s" in out and "cp=8" in out

@@ -113,3 +113,32 @@ def test_bert_model_flash_matches_composed():
     g2 = jax.grad(loss2)(params)
     errs = jax.tree.map(_max_err, g1, g2)
     assert max(jax.tree.leaves(errs)) < 5e-3
+
+
+def test_flash_attention_with_lse_fwd_bwd():
+    """(out, lse) variant: lse matches composed logsumexp, and grads are
+    correct INCLUDING a live lse cotangent (the ring-merge consumer)."""
+    from apex_tpu.ops.flash_attention import (
+        _with_lse_reference,
+        flash_attention_with_lse,
+    )
+
+    q, k, v = _mk(1, 2, 100, 100, 64, seed=5)
+    out, lse = flash_attention_with_lse(q, k, v, None, True, 0.125)
+    ref_out, ref_lse = _with_lse_reference(q, k, v, None, True, 0.125)
+    assert lse.shape == (1, 2, 1, 100)
+    assert _max_err(out, ref_out) < 2e-5
+    assert _max_err(lse, ref_lse) < 2e-5
+
+    def loss_k(q, k, v):
+        o, l = flash_attention_with_lse(q, k, v, None, True, 0.125)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(l))
+
+    def loss_r(q, k, v):
+        o, l = _with_lse_reference(q, k, v, None, True, 0.125)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(l))
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        assert _max_err(a, b) < 3e-4
