@@ -1,0 +1,77 @@
+"""Ulysses-style all-to-all sequence-parallel attention.
+
+The second long-context mechanism (besides :mod:`ring_attention`): the
+DeepSpeed-Ulysses decomposition. Sequence-sharded activations are
+re-sharded HEAD-wise for the attention core — one ``all_to_all``
+converts (B, H, S/cp, D) into (B, H/cp, S, D), each device runs flash
+attention over the FULL sequence for its head subset, and a second
+``all_to_all`` restores sequence sharding. Two collectives per
+attention — three with a padding mask, whose shards are all-gathered —
+(vs the ring's cp ppermute hops), at the cost of requiring
+``H % cp == 0`` and O(S) keys per device during the core (the ring
+keeps O(S/cp)).
+
+When to use which (both run inside ``shard_map`` over the context axis):
+- ``ulysses_attention``: moderate sequence lengths where a full-S k/v
+  block fits per device — fewer collectives, perfectly load-balanced
+  causal attention.
+- ``ring_attention``: extreme lengths where even one full-S k/v tensor
+  per device is too large.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+
+def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
+                      scale: float = 1.0, axis_name: str = "context"):
+    """Sequence-parallel attention via head re-sharding.
+
+    Args:
+      q, k, v: this device's (B, H, S_local, D) sequence shard
+        (contiguous sharding, like ring_attention).
+      key_mask: optional (B, S_local) boolean shard (True = masked).
+      causal: causal over global positions.
+      scale: softmax temperature.
+      axis_name: the context-parallel mesh axis; H must be divisible by
+        its size.
+
+    Returns:
+      (B, H, S_local, D) outputs for this device's sequence shard.
+    """
+    cp = jax.lax.psum(1, axis_name)
+    B, H, S_local, D = q.shape
+    if H % cp != 0:
+        raise ValueError(
+            f"ulysses_attention requires num_heads ({H}) divisible by the "
+            f"context axis size ({cp}); use ring_attention otherwise")
+
+    def to_heads(t):
+        # (B, H, S/cp, D) -> (B, H/cp, S, D): split heads, concat seq
+        return jax.lax.all_to_all(t, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    full_mask = None
+    if key_mask is not None:
+        from apex_tpu.utils.collectives import mark_varying
+
+        # an axis-invariant (e.g. default all-False) mask must be cast
+        # varying before the gather, same as ring_attention's rotation
+        full_mask = jax.lax.all_gather(
+            mark_varying(key_mask, axis_name), axis_name, axis=1,
+            tiled=True)
+    out = flash_attention(qh, kh, vh, full_mask, causal, scale)
+    # (B, H/cp, S, D) -> (B, H, S/cp, D)
+    return jax.lax.all_to_all(out, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+
+def ulysses_attention_reference(q_full, k_full, v_full, key_mask=None,
+                                causal=False, scale=1.0):
+    """Unsharded reference for parity tests."""
+    return mha_reference(q_full, k_full, v_full, key_mask, causal, scale)

@@ -36,6 +36,28 @@ def test_ring_attention_on_chip_aligned_and_unaligned():
             assert err < 5e-5, (S, causal, err)
 
 
+def test_ulysses_attention_on_chip():
+    from apex_tpu.ops.ulysses_attention import (
+        ulysses_attention,
+        ulysses_attention_reference,
+    )
+
+    mesh = jax.make_mesh((1,), ("context",))
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, 4, 384, 64))
+    k = jax.random.normal(ks[1], (1, 4, 384, 64))
+    v = jax.random.normal(ks[2], (1, 4, 384, 64))
+    km = jnp.zeros((1, 384), bool)
+    out = jax.jit(jax.shard_map(
+        lambda q, k, v, km: ulysses_attention(q, k, v, km, True, 0.125,
+                                              axis_name="context"),
+        mesh=mesh, in_specs=(P(),) * 4, out_specs=P(),
+        check_vma=False))(q, k, v, km)
+    with jax.default_matmul_precision("highest"):
+        ref = ulysses_attention_reference(q, k, v, None, True, 0.125)
+    assert float(jnp.max(jnp.abs(out - ref))) < 5e-5
+
+
 def test_zero_optimizers_step_on_chip():
     from apex_tpu.contrib.optimizers import (
         DistributedFusedAdam,
