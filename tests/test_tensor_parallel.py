@@ -132,7 +132,7 @@ def test_column_parallel_linear_matches_dense():
 
     y, full_w, full_b = _tp_map(f, x, out_specs=(P(), P(), P()))
     assert full_w.shape == (8, 16)  # 4 ranks x (8, 4) concatenated
-    # per-rank fold_in must decorrelate the shards
+    # master-init slicing must decorrelate the shards
     w = np.asarray(full_w)
     assert not np.allclose(w[:, :4], w[:, 4:8])
     np.testing.assert_allclose(np.asarray(y),
