@@ -4,3 +4,9 @@ from apex_tpu.models.bert import (  # noqa: F401
     BertModel,
     pretraining_loss,
 )
+from apex_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTLMHeadModel,
+    GPTModel,
+    lm_loss,
+)

@@ -1,0 +1,210 @@
+"""GPT-style causal language model — the decoder-family workload.
+
+Like :mod:`apex_tpu.models.bert`, the reference ships no models (apex is
+a library); this is the causal counterpart assembled from the same
+framework pieces: pre-LN blocks with Pallas FusedLayerNorm, causal flash
+attention (or ring / Ulysses context parallelism for long sequences),
+and the fused-logsumexp LM loss (no (B, S, V) log-prob tensor). For
+Megatron tensor/sequence parallelism see the BERT flagship, which wires
+the tensor_parallel layers; this model focuses on the context-parallel
+(long-sequence) axis.
+
+Attention backend selection (``attention_backend``):
+- ``"flash"`` (default): single-device Pallas flash attention, causal.
+- ``"ring"``: :func:`apex_tpu.ops.ring_attention` over the
+  ``context_axis`` mesh axis — activations arrive sequence-sharded
+  (B, S_local); O(S/cp) keys per device.
+- ``"ulysses"``: :func:`apex_tpu.ops.ulysses_attention` — all-to-all
+  head re-sharding; needs ``num_heads % cp == 0``.
+Both parallel backends require running inside ``shard_map`` with the
+context axis in scope (see ``examples/train_long_context.py`` for the
+mesh setup pattern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+
+_INIT = nn.initializers.normal(stddev=0.02)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    dropout: float = 0.1
+    layernorm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = True
+    fused_kernels: bool = True
+    attention_backend: str = "flash"   # flash | ring | ulysses
+    context_axis: str = "context"
+
+    @staticmethod
+    def gpt2_small(**kw):
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        return GPTConfig(**kw)
+
+
+def _dense(cfg, features, name):
+    return nn.Dense(features, dtype=cfg.dtype, param_dtype=jnp.float32,
+                    kernel_init=_INIT, name=name)
+
+
+def _norm(cfg, name):
+    if cfg.fused_kernels:
+        return FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                              name=name)
+    return nn.LayerNorm(epsilon=cfg.layernorm_eps, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name=name)
+
+
+def _causal_attend(cfg, q, k, v, scale):
+    """(B, nh, S, hd) causal attention via the selected backend."""
+    if cfg.attention_backend == "ring":
+        from apex_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, None, True, scale,
+                              axis_name=cfg.context_axis)
+    if cfg.attention_backend == "ulysses":
+        from apex_tpu.ops.ulysses_attention import ulysses_attention
+
+        return ulysses_attention(q, k, v, None, True, scale,
+                                 axis_name=cfg.context_axis)
+    if cfg.fused_kernels:
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, None, True, scale)
+    # composed fallback: the shared parity reference
+    from apex_tpu.ops.flash_attention import mha_reference
+
+    return mha_reference(q, k, v, None, True, scale)
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        h, nh = cfg.hidden_size, cfg.num_heads
+        hd = h // nh
+        B, S = x.shape[0], x.shape[1]
+
+        # pre-LN attention
+        y = _norm(cfg, "ln_1")(x)
+        qkv = _dense(cfg, 3 * h, "attn_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+
+        ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
+                             1.0 / (hd ** 0.5))
+        ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, S, h)
+        attn = _dense(cfg, h, "attn_out")(ctx)
+        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        x = x + attn
+
+        # pre-LN MLP
+        y = _norm(cfg, "ln_2")(x)
+        y = nn.gelu(_dense(cfg, 4 * h, "mlp_in")(y))
+        y = _dense(cfg, h, "mlp_out")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class GPTModel(nn.Module):
+    """Token + (sharded-aware) position embeddings, pre-LN blocks, final
+    norm. Returns hidden states; :class:`GPTLMHeadModel` adds the tied
+    LM head."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True,
+                 position_offset=0):
+        cfg = self.cfg
+        B, S_local = input_ids.shape
+        wte = self.param("wte", _INIT, (cfg.vocab_size, cfg.hidden_size),
+                         jnp.float32)
+        wpe = self.param("wpe", _INIT,
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         jnp.float32)
+        if cfg.attention_backend in ("ring", "ulysses"):
+            # sequence-sharded: this shard's global positions. Validate
+            # the table covers the GLOBAL sequence — dynamic_slice would
+            # silently clamp and duplicate positions otherwise.
+            cp = jax.lax.psum(1, cfg.context_axis)
+            rank = jax.lax.axis_index(cfg.context_axis)
+            static_off = (position_offset
+                          if isinstance(position_offset, int) else 0)
+            if isinstance(cp, int) and (static_off + cp * S_local
+                                        > cfg.max_position_embeddings):
+                raise ValueError(
+                    f"global sequence ({cp} shards x {S_local} + offset "
+                    f"{static_off}) exceeds max_position_embeddings "
+                    f"({cfg.max_position_embeddings}); dynamic_slice "
+                    "would silently clamp and duplicate positions")
+            position_offset = position_offset + rank * S_local
+        elif isinstance(position_offset, int) and (
+                position_offset + S_local > cfg.max_position_embeddings):
+            raise ValueError(
+                f"sequence [{position_offset}, {position_offset + S_local}) "
+                f"exceeds max_position_embeddings "
+                f"({cfg.max_position_embeddings})")
+        pos = jax.lax.dynamic_slice_in_dim(
+            wpe, position_offset, S_local, axis=0)
+        x = (wte[input_ids] + pos[None]).astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block_cls = GPTBlock
+        if cfg.remat:
+            block_cls = nn.remat(GPTBlock, static_argnums=(2,))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+        return _norm(cfg, "ln_f")(x), wte
+
+
+class GPTLMHeadModel(nn.Module):
+    """GPT with the weight-tied LM head (logits = hidden @ wte^T)."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True,
+                 position_offset=0):
+        x, wte = GPTModel(self.cfg, name="transformer")(
+            input_ids, deterministic, position_offset)
+        return jnp.einsum("bsh,vh->bsv", x, wte.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def lm_loss(logits, labels, ignore_index: int = -1):
+    """Shifted next-token cross-entropy via the fused logsumexp identity
+    (same memory rationale as bert.pretraining_loss)."""
+    lg = logits[:, :-1].astype(jnp.float32)
+    tgt = labels[:, 1:]
+    weights = (tgt != ignore_index).astype(jnp.float32)
+    safe = jnp.maximum(tgt, 0)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    picked = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
+    per_token = (lse - picked) * weights
+    return per_token.sum() / jnp.maximum(weights.sum(), 1.0)
