@@ -46,27 +46,33 @@ class Bottleneck(nn.Module):
     out_channels: int
     stride: int = 1
     use_cudnn: bool = False  # parity knob; ignored (XLA convs)
+    bn_group: int = 1                 # cross-replica BN (bnp group)
+    axis_name: Optional[str] = None
+
+    def _bn(self, ch, name, fuse_relu=False):
+        return BatchNorm2d_NHWC(ch, fuse_relu=fuse_relu,
+                                bn_group=self.bn_group,
+                                axis_name=self.axis_name, name=name)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         residual = x
         y = _conv(self.bottleneck_channels, 1, name="conv1")(x)
-        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
-                             name="bn1")(y, train=train)
+        y = self._bn(self.bottleneck_channels, "bn1", fuse_relu=True)(
+            y, train=train)
         y = _conv(self.bottleneck_channels, 3, self.stride,
                   name="conv2")(y)
-        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
-                             name="bn2")(y, train=train)
+        y = self._bn(self.bottleneck_channels, "bn2", fuse_relu=True)(
+            y, train=train)
         y = _conv(self.out_channels, 1, name="conv3")(y)
         if self.stride != 1 or self.in_channels != self.out_channels:
             residual = _conv(self.out_channels, 1, self.stride,
                              name="downsample_conv")(x)
-            residual = BatchNorm2d_NHWC(
-                self.out_channels, name="downsample_bn")(
+            residual = self._bn(self.out_channels, "downsample_bn")(
                 residual, train=train)
         # bn3 with the fused add+relu epilogue (z = residual)
-        return BatchNorm2d_NHWC(self.out_channels, fuse_relu=True,
-                                name="bn3")(y, z=residual, train=train)
+        return self._bn(self.out_channels, "bn3", fuse_relu=True)(
+            y, z=residual, train=train)
 
 
 class HaloExchanger1d:
@@ -112,6 +118,10 @@ class SpatialBottleneck(nn.Module):
     out_channels: int
     spatial_axis: str = "spatial"
     halo: int = 1
+    bn_group: int = 1                 # cross-replica BN (the reference
+    axis_name: Optional[str] = None   # runs group BN on spatial groups)
+
+    _bn = Bottleneck._bn
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -122,8 +132,8 @@ class SpatialBottleneck(nn.Module):
                 "side (use HaloExchanger1d directly for wider halos)")
         residual = x
         y = _conv(self.bottleneck_channels, 1, name="conv1")(x)
-        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
-                             name="bn1")(y, train=train)
+        y = self._bn(self.bottleneck_channels, "bn1", fuse_relu=True)(
+            y, train=train)
         # 3x3 with cross-shard receptive field: pad with neighbor halos,
         # convolve VALID-in-H, trimming the halo contribution exactly
         exchanger = HaloExchanger1d(self.spatial_axis, self.halo)
@@ -133,13 +143,12 @@ class SpatialBottleneck(nn.Module):
                     param_dtype=jnp.float32,
                     kernel_init=nn.initializers.he_normal(),
                     name="conv2")(y)
-        y = BatchNorm2d_NHWC(self.bottleneck_channels, fuse_relu=True,
-                             name="bn2")(y, train=train)
+        y = self._bn(self.bottleneck_channels, "bn2", fuse_relu=True)(
+            y, train=train)
         y = _conv(self.out_channels, 1, name="conv3")(y)
         if self.in_channels != self.out_channels:
             residual = _conv(self.out_channels, 1, name="downsample_conv")(x)
-            residual = BatchNorm2d_NHWC(
-                self.out_channels, name="downsample_bn")(
+            residual = self._bn(self.out_channels, "downsample_bn")(
                 residual, train=train)
-        return BatchNorm2d_NHWC(self.out_channels, fuse_relu=True,
-                                name="bn3")(y, z=residual, train=train)
+        return self._bn(self.out_channels, "bn3", fuse_relu=True)(
+            y, z=residual, train=train)

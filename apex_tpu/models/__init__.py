@@ -10,3 +10,4 @@ from apex_tpu.models.gpt import (  # noqa: F401
     GPTModel,
     lm_loss,
 )
+from apex_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401

@@ -1,0 +1,82 @@
+"""ResNet (NHWC) — the MLPerf-ResNet workload family.
+
+BASELINE configs[3] names "DDP + SyncBatchNorm scaling, ResNet-50"; like
+BERT/GPT this model exists to exercise the framework's conv tier end to
+end: :class:`~apex_tpu.contrib.bottleneck.Bottleneck` blocks (NHWC convs
++ BatchNorm with the fused residual add+ReLU epilogue), optional
+cross-replica BN via ``bn_group``/``axis_name`` (the groupbn/SyncBN
+machinery), and DDP-style data parallelism at the train-step level.
+
+NHWC is the native TPU conv layout (C on the 128-lane minor dim) — the
+whole reason the reference's groupbn/bottleneck contrib tier exists is
+to get torch onto that layout; here it is the default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.bottleneck import Bottleneck
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    # blocks per stage; (3, 4, 6, 3) = ResNet-50
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    width: int = 64
+    bn_group: int = 1                 # cross-replica BN group size
+    axis_name: Optional[str] = None   # mesh axis for BN sync
+
+    @staticmethod
+    def resnet50(**kw):
+        return ResNetConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("stage_sizes", (1, 1))
+        kw.setdefault("width", 16)
+        return ResNetConfig(**kw)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet over (N, H, W, C) inputs."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        w = cfg.width
+        x = nn.Conv(w, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                    use_bias=False, param_dtype=jnp.float32,
+                    kernel_init=nn.initializers.he_normal(),
+                    name="conv_stem")(x)
+        x = BatchNorm2d_NHWC(w, fuse_relu=True, bn_group=cfg.bn_group,
+                             axis_name=cfg.axis_name,
+                             name="bn_stem")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        in_ch = w
+        for stage, blocks in enumerate(cfg.stage_sizes):
+            out_ch = w * (2 ** stage) * 4
+            mid_ch = w * (2 ** stage)
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = Bottleneck(in_ch, mid_ch, out_ch, stride=stride,
+                               bn_group=cfg.bn_group,
+                               axis_name=cfg.axis_name,
+                               name=f"stage{stage}_block{b}")(x, train=train)
+                in_ch = out_ch
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(cfg.num_classes, param_dtype=jnp.float32,
+                        kernel_init=nn.initializers.zeros,
+                        name="fc")(x)
