@@ -48,6 +48,15 @@ class GPTConfig:
     fused_kernels: bool = True
     attention_backend: str = "flash"   # flash | ring | ulysses
     context_axis: str = "context"
+    # Mixture-of-experts (0 = dense MLP). Experts shard over the
+    # ``expert`` mesh axis when parallel_state is initialized with
+    # expert_model_parallel_size_ > 1; see apex_tpu.transformer.moe.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_layer_freq: int = 1   # every Nth block is MoE (1 = all)
+    moe_aux_loss_coeff: float = 0.01
+    moe_z_loss_coeff: float = 1e-3
 
     @staticmethod
     def gpt2_small(**kw):
@@ -100,6 +109,7 @@ def _causal_attend(cfg, q, k, v, scale):
 
 class GPTBlock(nn.Module):
     cfg: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -123,10 +133,22 @@ class GPTBlock(nn.Module):
         attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
         x = x + attn
 
-        # pre-LN MLP
+        # pre-LN MLP (dense or mixture-of-experts)
         y = _norm(cfg, "ln_2")(x)
-        y = nn.gelu(_dense(cfg, 4 * h, "mlp_in")(y))
-        y = _dense(cfg, h, "mlp_out")(y)
+        if self.use_moe:
+            from apex_tpu.transformer.moe import MoEMLP
+
+            y, aux, z = MoEMLP(
+                hidden_size=h, ffn_hidden_size=4 * h,
+                num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype, name="moe_mlp",
+            )(y, deterministic=deterministic)
+            self.sow("losses", "moe_aux_loss", cfg.moe_aux_loss_coeff * aux)
+            self.sow("losses", "moe_z_loss", cfg.moe_z_loss_coeff * z)
+        else:
+            y = nn.gelu(_dense(cfg, 4 * h, "mlp_in")(y))
+            y = _dense(cfg, h, "mlp_out")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return x + y
 
@@ -179,7 +201,9 @@ class GPTModel(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(GPTBlock, static_argnums=(2,))
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+            use_moe = (cfg.num_experts > 0
+                       and i % max(cfg.moe_layer_freq, 1) == 0)
+            x = block_cls(cfg, use_moe, name=f"h_{i}")(x, deterministic)
         return _norm(cfg, "ln_f")(x), wte
 
 
@@ -195,6 +219,18 @@ class GPTLMHeadModel(nn.Module):
             input_ids, deterministic, position_offset)
         return jnp.einsum("bsh,vh->bsv", x, wte.astype(x.dtype),
                           preferred_element_type=jnp.float32)
+
+
+def moe_losses_total(collections):
+    """Sum the sown MoE auxiliary losses from an ``apply(...,
+    mutable=("losses",))`` result: ``logits, col = model.apply(...);
+    loss = lm_loss(...) + moe_losses_total(col)``. Returns 0.0 for dense
+    models (empty/missing collection)."""
+    losses = collections.get("losses", {}) if collections else {}
+    total = jnp.float32(0.0)
+    for leaf in jax.tree.leaves(losses):
+        total = total + jnp.sum(leaf)
+    return total
 
 
 def lm_loss(logits, labels, ignore_index: int = -1):

@@ -7,3 +7,5 @@ from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import functional  # noqa: F401
 from apex_tpu.transformer import microbatches  # noqa: F401
+from apex_tpu.transformer import moe  # noqa: F401
+from apex_tpu.transformer.moe import MoEMLP, route_top_k  # noqa: F401

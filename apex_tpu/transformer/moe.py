@@ -1,0 +1,231 @@
+"""Mixture-of-experts layer with expert parallelism.
+
+The reference (apex) predates MoE and has no expert subsystem; this
+module extends the Megatron-style transformer tier
+(``apex/transformer/`` (U), SURVEY.md §2.3) with the one parallelism
+axis the reference lacks, designed TPU-first:
+
+- **Static-capacity routing** (Switch/GShard style): every expert
+  processes exactly ``capacity`` token slots per step, so all shapes are
+  static and XLA can tile every matmul onto the MXU. Overflow tokens are
+  dropped (their combine weight is zero, the residual stream carries
+  them through), underflow slots are zero-padded — the standard TPU
+  trade against dynamic gather/scatter, which Mosaic cannot lower and
+  XLA cannot tile.
+- **Dispatch/combine as one-hot einsums**: token→slot routing is a
+  (T, E, C) 0/1 tensor contracted on the MXU, not a scatter.
+- **Expert parallelism over the ``expert`` mesh axis**
+  (:data:`apex_tpu.transformer.parallel_state.EXPERT_AXIS`):
+  ``jax.lax.all_to_all`` exchanges token slots so each rank computes only
+  its local experts; with ``ep == 1`` no collective is emitted and the
+  layer runs unchanged on a single device.
+- **fp32 router**: gate logits/softmax/losses in float32 regardless of
+  activation dtype (bf16 routing is known to destabilize training).
+
+Losses follow the Switch Transformer recipe: ``aux_loss`` is the
+load-balance term ``E * mean(fraction_dispatched * mean_gate_prob)``
+(minimized at uniform routing, where it equals 1), ``z_loss`` is
+``mean(logsumexp(logits)^2)`` to keep router logits from drifting.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.collectives import axis_is_bound, mark_varying
+
+
+class RouterOutput(NamedTuple):
+    """Routing decision for one batch of tokens.
+
+    dispatch: (T, E, C) 0/1 — token t goes to slot c of expert e.
+    combine:  (T, E, C) fp32 — dispatch scaled by the gate probability.
+    aux_loss: scalar load-balance loss (Switch Transformer eq. 4-6).
+    z_loss:   scalar router z-loss.
+    """
+
+    dispatch: jax.Array
+    combine: jax.Array
+    aux_loss: jax.Array
+    z_loss: jax.Array
+
+
+def route_top_k(logits, k: int, capacity: int) -> RouterOutput:
+    """Top-k static-capacity routing (GShard order: the k-th choices of
+    all tokens queue behind every token's (k-1)-th choice, so a token's
+    primary expert is only dropped if the expert is full of primaries).
+
+    logits: (T, E) fp32 router scores. Returns :class:`RouterOutput`.
+    """
+    T, E = logits.shape
+    if k > E:
+        raise ValueError(f"top-k ({k}) exceeds number of experts ({E}): "
+                         "later rounds would re-dispatch expert 0")
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    remaining = probs
+    used = jnp.zeros((T, E), jnp.float32)  # experts already chosen per token
+    fill = jnp.zeros((E,), jnp.float32)    # slots already taken per expert
+    frac_dispatched = jnp.zeros((E,), jnp.float32)
+
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)            # (T,)
+        mask = jax.nn.one_hot(choice, E, dtype=jnp.float32)
+        gate = jnp.sum(probs * mask, axis=-1)              # (T,)
+        # arrival order within the expert, offset by earlier rounds' fill
+        order = jnp.cumsum(mask, axis=0) * mask            # 1-based
+        position = order + fill[None, :] * mask - 1.0
+        keep = (position < capacity) & (mask > 0)
+        position = jnp.where(keep, position, 0).astype(jnp.int32)
+        keepf = keep.astype(jnp.float32)                   # (T, E)
+        slot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+        contrib = mask[:, :, None] * keepf[:, :, None] * slot
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[:, None, None]
+        frac_dispatched = frac_dispatched + jnp.sum(mask, axis=0) / T
+        fill = fill + jnp.sum(mask * keepf, axis=0)
+        used = used + mask
+        remaining = jnp.where(used > 0, -jnp.inf, remaining)
+
+    # Switch load-balance loss over the PRIMARY assignment distribution
+    mean_prob = jnp.mean(probs, axis=0)                    # (E,)
+    aux_loss = E * jnp.sum((frac_dispatched / k) * mean_prob)
+    z = jax.nn.logsumexp(logits, axis=-1)
+    return RouterOutput(dispatch, combine, aux_loss, jnp.mean(z * z))
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-experts MLP block (drop-in for a dense transformer MLP).
+
+    ``num_experts`` is the GLOBAL expert count; with expert parallelism
+    each rank holds ``num_experts // ep`` experts, initialized from a
+    rank-folded key (experts are decorrelated across ranks by design —
+    unlike TP shards, expert weights are independent parameters, not
+    slices of a master matrix). Token slots travel between ranks via
+    ``all_to_all`` over :data:`parallel_state.EXPERT_AXIS`.
+
+    Expert-parallel gradient flow: expert params are varying over the
+    ``expert`` axis; their cotangents stay per-rank (no sync needed
+    beyond ``data``-axis DP, see
+    :func:`parallel_state.get_expert_data_parallel_group`).
+    """
+
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    activation: Callable = nn.gelu
+    router_jitter: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        """x: (..., hidden) -> (y, aux_loss, z_loss). Flattens leading
+        dims to a token axis internally."""
+        ep = parallel_state.get_expert_model_parallel_world_size()
+        # Abstract tracing outside shard_map (eval_shape for spec trees):
+        # the expert axis is unbound, so skip collectives/rank folding —
+        # every op in the skipped set is shape-preserving, so derived
+        # shapes stay correct.
+        bound = ep == 1 or axis_is_bound(parallel_state.EXPERT_AXIS)
+        E, H, F = self.num_experts, self.hidden_size, self.ffn_hidden_size
+        if E % ep != 0:
+            raise ValueError(
+                f"num_experts ({E}) not divisible by expert parallel size "
+                f"({ep})")
+        if self.top_k > E:
+            raise ValueError(
+                f"top_k ({self.top_k}) exceeds num_experts ({E})")
+        e_local = E // ep
+
+        lead = x.shape[:-1]
+        tokens = x.reshape(-1, H)
+        T = tokens.shape[0]
+        capacity = max(1, int(-(-self.top_k * T * self.capacity_factor
+                                // E)))  # ceil, static
+
+        # --- router (fp32, replicated over the expert axis) ---
+        wr = self.param("router", nn.initializers.normal(stddev=0.02),
+                        (H, E), self.params_dtype)
+        logits = tokens.astype(jnp.float32) @ wr.astype(jnp.float32)
+        if self.router_jitter and not deterministic:
+            key = self.make_rng("dropout")
+            logits = logits * jax.random.uniform(
+                key, logits.shape, jnp.float32,
+                1.0 - self.router_jitter, 1.0 + self.router_jitter)
+        routing = route_top_k(logits, self.top_k, capacity)
+
+        # --- expert weights: e_local experts per rank, rank-folded init ---
+        def expert_init(key, s, d):
+            if ep > 1 and bound:
+                key = jax.random.fold_in(
+                    key, parallel_state.get_expert_model_parallel_rank())
+            # fan-in scaled over the per-expert matrix, not the stack
+            fan_in = s[1]
+            return jax.random.normal(key, s, d) / jnp.sqrt(fan_in)
+
+        w1 = self.param("w1", expert_init, (e_local, H, F),
+                        self.params_dtype)
+        b1 = self.param("b1", nn.initializers.zeros, (e_local, F),
+                        self.params_dtype)
+        w2 = self.param("w2", expert_init, (e_local, F, H),
+                        self.params_dtype)
+        b2 = self.param("b2", nn.initializers.zeros, (e_local, H),
+                        self.params_dtype)
+        if ep > 1 and bound:
+            w1, b1, w2, b2 = mark_varying(
+                (w1, b1, w2, b2), parallel_state.EXPERT_AXIS)
+
+        def a2a(t):
+            """all_to_all over the expert axis (identity when tracing
+            outside shard_map — shape-preserving, so eval_shape-derived
+            spec trees stay correct)."""
+            if not bound:
+                return t
+            return jax.lax.all_to_all(t, parallel_state.EXPERT_AXIS,
+                                      split_axis=0, concat_axis=0,
+                                      tiled=False)
+
+        # --- dispatch: (T, E, C) x (T, H) -> (E, C, H) on the MXU ---
+        slots = jnp.einsum("tec,th->ech",
+                           routing.dispatch.astype(self.dtype),
+                           tokens.astype(self.dtype))
+        if ep > 1:
+            # (E, C, H) -> (ep, e_local, C, H); all_to_all swaps the ep
+            # shard dim for the token-source dim: each rank ends up with
+            # ITS experts' slots from ALL ep ranks.
+            slots = a2a(slots.reshape(ep, e_local, capacity, H))
+            # (ep_src, e_local, C, H) -> (e_local, ep_src*C, H): each local
+            # expert batches its slots from every source rank
+            slots = slots.transpose(1, 0, 2, 3).reshape(
+                e_local, ep * capacity, H)
+
+        # --- expert computation (batched over local experts) ---
+        h = jnp.einsum("ech,ehf->ecf", slots, w1.astype(self.dtype))
+        h = self.activation(h + b1[:, None, :].astype(self.dtype))
+        out = jnp.einsum("ecf,efh->ech", h, w2.astype(self.dtype))
+        out = out + b2[:, None, :].astype(self.dtype)
+
+        if ep > 1:
+            # (e_local, ep_src*C, H) -> (ep_src, e_local, C, H), send each
+            # source rank's slots home; after the exchange dim0 indexes the
+            # expert's OWNER rank, so the flat view is global expert order.
+            out = a2a(out.reshape(e_local, ep, capacity, H)
+                      .transpose(1, 0, 2, 3))
+            out = out.reshape(E, capacity, H)
+
+        # --- combine: weighted un-dispatch back to token order ---
+        y = jnp.einsum("ech,tec->th", out.astype(jnp.float32),
+                       routing.combine)
+        return (y.astype(self.dtype).reshape(*lead, H),
+                routing.aux_loss, routing.z_loss)

@@ -31,11 +31,13 @@ _MESH: Optional[Mesh] = None
 _TP_SIZE = 1
 _PP_SIZE = 1
 _DP_SIZE = 1
+_EP_SIZE = 1
 _VIRTUAL_PP_SIZE: Optional[int] = None
 
 TENSOR_AXIS = "tensor"
 PIPELINE_AXIS = "pipeline"
 DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
 
 
 def initialize_model_parallel(
@@ -43,27 +45,43 @@ def initialize_model_parallel(
     pipeline_model_parallel_size_: int = 1,
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     pipeline_model_parallel_split_rank_: Optional[int] = None,
+    expert_model_parallel_size_: int = 1,
     *,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build and install the global mesh (reference:
     ``initialize_model_parallel``). Data-parallel size is inferred as
-    ``world // (tp * pp)``, exactly like the reference."""
-    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _VIRTUAL_PP_SIZE
+    ``world // (tp * pp * ep)``, exactly like the reference (which has no
+    ep; with the default ``expert_model_parallel_size_=1`` the mesh
+    degenerates to the reference's tp/pp/dp factorization — the expert
+    axis still exists but has size 1, so every spec and collective that
+    names it is a no-op).
+
+    Expert parallelism follows the Megatron-LM convention: ep is carved
+    out of the data-parallel group, so non-expert parameters are
+    replicated over (data, expert) while expert parameters are replicated
+    over data only and SHARDED over expert. Gradient sync therefore uses
+    :func:`get_data_parallel_group` (→ ``("data", "expert")``) for dense
+    params and :func:`get_expert_data_parallel_group` (→ ``"data"``) for
+    expert params."""
+    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE, _VIRTUAL_PP_SIZE
 
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
     tp = int(tensor_model_parallel_size_)
     pp = int(pipeline_model_parallel_size_)
-    if world % (tp * pp) != 0:
+    ep = int(expert_model_parallel_size_)
+    if world % (tp * pp * ep) != 0:
         raise RuntimeError(
             f"world size ({world}) is not divisible by tensor parallel size "
-            f"({tp}) times pipeline parallel size ({pp})"
+            f"({tp}) times pipeline parallel size ({pp}) times expert "
+            f"parallel size ({ep})"
         )
-    dp = world // (tp * pp)
-    dev_array = np.asarray(devices).reshape(pp, dp, tp)
-    _MESH = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, TENSOR_AXIS))
-    _TP_SIZE, _PP_SIZE, _DP_SIZE = tp, pp, dp
+    dp = world // (tp * pp * ep)
+    dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+    _MESH = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, EXPERT_AXIS,
+                             TENSOR_AXIS))
+    _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE = tp, pp, dp, ep
     _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
     return _MESH
 
@@ -73,9 +91,9 @@ def model_parallel_is_initialized() -> bool:
 
 
 def destroy_model_parallel():
-    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _VIRTUAL_PP_SIZE
+    global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE, _VIRTUAL_PP_SIZE
     _MESH = None
-    _TP_SIZE = _PP_SIZE = _DP_SIZE = 1
+    _TP_SIZE = _PP_SIZE = _DP_SIZE = _EP_SIZE = 1
     _VIRTUAL_PP_SIZE = None
 
 
@@ -95,7 +113,23 @@ def get_pipeline_model_parallel_group() -> str:
     return PIPELINE_AXIS
 
 
-def get_data_parallel_group() -> str:
+def get_data_parallel_group():
+    """Axis name(s) for full data-parallel gradient sync of DENSE (non-
+    expert) params. With ep>1 this is the ("data", "expert") axis pair —
+    dense params are replicated over both — and jax collectives accept
+    the tuple directly."""
+    if _EP_SIZE > 1:
+        return (DATA_AXIS, EXPERT_AXIS)
+    return DATA_AXIS
+
+
+def get_expert_model_parallel_group() -> str:
+    return EXPERT_AXIS
+
+
+def get_expert_data_parallel_group() -> str:
+    """Axis for gradient sync of EXPERT params (which are sharded over
+    ``expert``, replicated over ``data`` only)."""
     return DATA_AXIS
 
 
@@ -110,7 +144,22 @@ def get_pipeline_model_parallel_world_size() -> int:
 
 
 def get_data_parallel_world_size() -> int:
+    """Size of the FULL data-parallel replica group — ``world //
+    (tp * pp)``, matching the reference and pairing with
+    :func:`get_data_parallel_group` (with ep>1 that group is the
+    ("data", "expert") axis pair, so this is ``dp * ep``; the raw
+    ``data`` mesh-axis size is :func:`get_expert_data_parallel_world_size`)."""
+    return _DP_SIZE * _EP_SIZE
+
+
+def get_expert_data_parallel_world_size() -> int:
+    """Size of the ``data`` mesh axis alone — the replica group of
+    EXPERT params (pairs with :func:`get_expert_data_parallel_group`)."""
     return _DP_SIZE
+
+
+def get_expert_model_parallel_world_size() -> int:
+    return _EP_SIZE
 
 
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
@@ -130,6 +179,11 @@ def get_pipeline_model_parallel_rank():
 
 def get_data_parallel_rank():
     return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_expert_model_parallel_rank():
+    """Traced EP rank; requires a bound ``expert`` axis."""
+    return jax.lax.axis_index(EXPERT_AXIS)
 
 
 def is_pipeline_first_stage(ignore_virtual: bool = True):

@@ -29,16 +29,22 @@ def mark_varying(x, axis_names):
     return jax.tree.map(one, x)
 
 
-def axis_is_bound(axis_name: str):
+def axis_is_bound(axis_name: str) -> bool:
     """Whether ``axis_name`` is currently a bound collective axis
-    (inside shard_map/pmap over it). Returns None if undeterminable on
-    this JAX version."""
+    (inside shard_map/pmap over it). Always returns a bool: if the
+    axis-env introspection API moves (it is private), falls back to
+    probing ``axis_index``, which raises NameError on unbound names."""
     try:
         from jax._src import core as _core
 
-        return _core.get_axis_env().axis_exists(axis_name)
+        return bool(_core.get_axis_env().axis_exists(axis_name))
     except Exception:
-        return None
+        pass
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
 
 
 def psum_groups(x, axis_name: str, groups: Optional[Sequence[Sequence[int]]] = None):

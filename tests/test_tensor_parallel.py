@@ -45,7 +45,8 @@ def test_parallel_state_sizes():
     assert parallel_state.get_pipeline_model_parallel_world_size() == 1
     assert parallel_state.model_parallel_is_initialized()
     mesh = parallel_state.get_mesh()
-    assert mesh.shape == {"pipeline": 1, "data": 2, "tensor": 4}
+    assert mesh.shape == {"pipeline": 1, "data": 2, "expert": 1,
+                          "tensor": 4}
 
 
 def test_parallel_state_validation():
