@@ -112,9 +112,17 @@ class MoEMLP(nn.Module):
     slices of a master matrix). Token slots travel between ranks via
     ``all_to_all`` over :data:`parallel_state.EXPERT_AXIS`.
 
+    Composes with tensor parallelism (Megatron TPxEP): when the mesh has
+    ``tp > 1``, each expert's FFN is additionally column/row-split over
+    the ``tensor`` axis (master-weight init: the full per-expert matrix
+    from the shared key, tp rank slices its shard) and the row-parallel
+    partials are psum'd. Input tokens must then be REPLICATED over the
+    tensor axis (the usual Megatron placement: MoE sits where activations
+    are tp-replicated; compose with SP gather/scatter outside if used).
+
     Expert-parallel gradient flow: expert params are varying over the
-    ``expert`` axis; their cotangents stay per-rank (no sync needed
-    beyond ``data``-axis DP, see
+    ``expert`` (and, with tp>1, ``tensor``) axes; their cotangents stay
+    per-rank (no sync needed beyond ``data``-axis DP, see
     :func:`parallel_state.get_expert_data_parallel_group`).
     """
 
@@ -127,6 +135,11 @@ class MoEMLP(nn.Module):
     router_jitter: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     params_dtype: jnp.dtype = jnp.float32
+    # tp>1 only: False skips materializing the full per-expert matrix at
+    # init (same escape hatch as tensor_parallel.layers for weights too
+    # large per rank). Variance-correct either way here: the init scales
+    # by the FULL fan-in explicitly, not shard shape.
+    master_weight_init: bool = True
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -165,26 +178,66 @@ class MoEMLP(nn.Module):
                 1.0 - self.router_jitter, 1.0 + self.router_jitter)
         routing = route_top_k(logits, self.top_k, capacity)
 
-        # --- expert weights: e_local experts per rank, rank-folded init ---
-        def expert_init(key, s, d):
-            if ep > 1 and bound:
-                key = jax.random.fold_in(
-                    key, parallel_state.get_expert_model_parallel_rank())
-            # fan-in scaled over the per-expert matrix, not the stack
-            fan_in = s[1]
-            return jax.random.normal(key, s, d) / jnp.sqrt(fan_in)
+        # --- expert weights: e_local experts per rank (rank-folded key),
+        # each expert's FFN optionally tensor-parallel: w1 column-split /
+        # w2 row-split over the ``tensor`` axis (Megatron TPxEP grouped
+        # GEMM), using the same master-weight init scheme as
+        # tensor_parallel.layers — the full per-expert matrix is drawn
+        # from the (ep-folded) key and the tp rank slices its shard, so
+        # fan-in scaling sees the full matrix and the assembled weight is
+        # independent of tp.
+        tp = parallel_state.get_tensor_model_parallel_world_size()
+        tp_bound = tp == 1 or axis_is_bound(parallel_state.TENSOR_AXIS)
+        if F % tp != 0:
+            raise ValueError(
+                f"ffn_hidden_size ({F}) not divisible by tensor parallel "
+                f"size ({tp})")
+        f_local = F // tp
 
-        w1 = self.param("w1", expert_init, (e_local, H, F),
+        def expert_init(slice_axis):
+            # the same master-weight scheme as tensor_parallel.layers.
+            # _master_init, inlined because the full fan-in (full[1]) is
+            # known here even on the per-shard fallback path, which makes
+            # master_weight_init=False variance-correct (unlike generic
+            # fan-scaled initializers over a shard shape)
+            def init(key, s, d):
+                if ep > 1 and bound:
+                    key = jax.random.fold_in(
+                        key, parallel_state.get_expert_model_parallel_rank())
+                full = list(s)
+                full[slice_axis] = full[slice_axis] * tp
+                scale = 1.0 / jnp.sqrt(full[1])  # FULL per-expert fan-in
+                if tp == 1:
+                    return jax.random.normal(key, tuple(full), d) * scale
+                if not self.master_weight_init:
+                    if tp_bound:
+                        key = jax.random.fold_in(
+                            key,
+                            parallel_state.get_tensor_model_parallel_rank())
+                    return jax.random.normal(key, s, d) * scale
+                w = jax.random.normal(key, tuple(full), d) * scale
+                starts = [0] * len(full)
+                if tp_bound:
+                    starts[slice_axis] = (
+                        parallel_state.get_tensor_model_parallel_rank()
+                        * s[slice_axis])
+                return jax.lax.dynamic_slice(w, starts, s)
+            return init
+
+        w1 = self.param("w1", expert_init(2), (e_local, H, f_local),
                         self.params_dtype)
-        b1 = self.param("b1", nn.initializers.zeros, (e_local, F),
+        b1 = self.param("b1", nn.initializers.zeros, (e_local, f_local),
                         self.params_dtype)
-        w2 = self.param("w2", expert_init, (e_local, F, H),
+        w2 = self.param("w2", expert_init(1), (e_local, f_local, H),
                         self.params_dtype)
         b2 = self.param("b2", nn.initializers.zeros, (e_local, H),
                         self.params_dtype)
         if ep > 1 and bound:
             w1, b1, w2, b2 = mark_varying(
                 (w1, b1, w2, b2), parallel_state.EXPERT_AXIS)
+        if tp > 1 and tp_bound:
+            w1, b1, w2 = mark_varying((w1, b1, w2),
+                                      parallel_state.TENSOR_AXIS)
 
         def a2a(t):
             """all_to_all over the expert axis (identity when tracing
@@ -210,10 +263,14 @@ class MoEMLP(nn.Module):
             slots = slots.transpose(1, 0, 2, 3).reshape(
                 e_local, ep * capacity, H)
 
-        # --- expert computation (batched over local experts) ---
+        # --- expert computation (batched over local experts; with tp>1
+        # each rank computes its f_local slice and the row-parallel
+        # partials are psum'd over the tensor axis, bias added once) ---
         h = jnp.einsum("ech,ehf->ecf", slots, w1.astype(self.dtype))
         h = self.activation(h + b1[:, None, :].astype(self.dtype))
         out = jnp.einsum("ecf,efh->ech", h, w2.astype(self.dtype))
+        if tp > 1 and tp_bound:
+            out = jax.lax.psum(out, parallel_state.TENSOR_AXIS)
         out = out + b2[:, None, :].astype(self.dtype)
 
         if ep > 1:
