@@ -32,27 +32,28 @@ _SRC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                     "csrc", "flatten_unflatten.c")
 
 
-def _build_and_load() -> Optional[ctypes.CDLL]:
-    global _LIB, _TRIED
-    if _TRIED:
-        return _LIB
-    _TRIED = True
-    src = os.path.abspath(_SRC)
+def build_ctypes_lib(src_path: str, name: str) -> Optional[ctypes.CDLL]:
+    """Compile a single C source to a shared lib and dlopen it.
+
+    Shared by every native module (this one, :mod:`apex_tpu.data`): the
+    cache is keyed by source CONTENT (mtime lies across checkouts) under
+    a per-uid temp dir, built to a temp name + atomic rename so
+    concurrent processes never dlopen a half-written file, with a
+    cc/gcc/clang fallback chain. Returns None when no compiler works —
+    callers keep a numpy fallback."""
+    src = os.path.abspath(src_path)
     if not os.path.exists(src):
         return None
     cache = os.path.join(tempfile.gettempdir(),
                          f"apex_tpu_native_{os.getuid()}")
     os.makedirs(cache, exist_ok=True)
-    # key the cache by source content (mtime lies across checkouts) …
     import hashlib
 
     with open(src, "rb") as f:
         digest = hashlib.sha1(f.read()).hexdigest()[:16]
-    lib_path = os.path.join(cache, f"flatten_unflatten-{digest}.so")
+    lib_path = os.path.join(cache, f"{name}-{digest}.so")
     try:
         if not os.path.exists(lib_path):
-            # … and build to a temp name + atomic rename so concurrent
-            # processes never dlopen a half-written file
             fd, tmp_path = tempfile.mkstemp(suffix=".so", dir=cache)
             os.close(fd)
             for cc in ("cc", "gcc", "clang"):
@@ -68,16 +69,25 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             else:
                 os.unlink(tmp_path)
                 return None
-        lib = ctypes.CDLL(lib_path)
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    lib = build_ctypes_lib(_SRC, "flatten_unflatten")
+    if lib is not None:
         lib.apex_flatten.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
             ctypes.c_size_t, ctypes.c_void_p]
         lib.apex_unflatten.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t]
-        _LIB = lib
-    except OSError:
-        _LIB = None
+    _LIB = lib
     return _LIB
 
 

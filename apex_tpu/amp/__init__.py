@@ -29,3 +29,42 @@ from apex_tpu.amp.frontend import (  # noqa: F401
 )
 from apex_tpu.amp.handle import AmpHandle  # noqa: F401
 from apex_tpu.amp.scaler import DynamicLossScaler, LossScaler, ScalerState  # noqa: F401
+
+from apex_tpu.amp import _amp_state as _amp_state_mod
+
+
+def _current_handle() -> AmpHandle:
+    h = _amp_state_mod._amp_state.handle
+    if h is None:
+        raise RuntimeError(
+            "Invoked amp function before calling amp.initialize()")
+    return h
+
+
+def scale_loss(loss, state, loss_id: int = 0):
+    """Module-level ``amp.scale_loss`` (reference parity): delegates to
+    the handle returned by the most recent :func:`initialize`."""
+    return _current_handle().scale_loss(loss, state, loss_id)
+
+
+def state_dict():
+    """Module-level ``amp.state_dict()`` (reference parity)."""
+    return _current_handle().state_dict()
+
+
+def load_state_dict(sd):
+    """Module-level ``amp.load_state_dict()`` (reference parity)."""
+    return _current_handle().load_state_dict(sd)
+
+
+def master_params(optimizer_state):
+    """Iterate the fp32 master params held in a Fused* optimizer state
+    (reference: ``amp.master_params(optimizer)``, the generator training
+    scripts use for grad clipping on masters). Empty iterator when the
+    optimizer runs without master weights (O0/O1)."""
+    import jax as _jax
+
+    master = getattr(optimizer_state, "master", None)
+    if master is None:
+        return iter(())
+    return iter(_jax.tree.leaves(master))

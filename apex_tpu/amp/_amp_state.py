@@ -13,6 +13,10 @@ class AmpState:
     def __init__(self):
         self.verbosity = 1
         self.allow_incoming_model_not_fp32 = False
+        # last handle returned by amp.initialize — backs the module-level
+        # amp.scale_loss/state_dict conveniences (reference keeps the same
+        # process-global handle in its _amp_state)
+        self.handle = None
         # None = auto-detect: in-graph overflow logging uses jax.debug.print
         # (a host callback), which some TPU runtimes (axon PJRT) reject at
         # run time. Auto enables it only on the CPU backend; set explicitly

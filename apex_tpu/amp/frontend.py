@@ -211,11 +211,13 @@ def initialize(
         # absent" but with the full API surface intact — so hand back a
         # static unity scaler whose update is a no-op.
         properties.patch_torch_functions = False
-        return params, optimizers, AmpHandle(
+        handle = AmpHandle(
             properties,
             [LossScaler(loss_scale=1.0, loss_id=i) for i in range(num_losses)],
             autocast(enabled=False),
         )
+        _amp_state._amp_state.handle = handle
+        return params, optimizers, handle
 
     # Model casting (O2/O3).
     if properties.cast_model_type is not None and properties.cast_model_type != jnp.float32:
@@ -257,4 +259,5 @@ def initialize(
         enabled=properties.patch_torch_functions,
     )
     handle = AmpHandle(properties, scalers, cast_ctx)
+    _amp_state._amp_state.handle = handle
     return params, optimizers, handle

@@ -7,5 +7,15 @@ from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (
     conv_frozen_scale_bias_relu,
 )
 
-__all__ = ["conv_bias", "conv_bias_mask_relu", "conv_bias_relu",
-           "conv_frozen_scale_bias_relu"]
+# Reference name parity: the upstream module exposes CamelCase
+# autograd-Function handles (ConvBiasReLU etc.); here the fused op IS the
+# function (XLA fuses the epilogue), so the aliases point at the same
+# callables.
+ConvBias = conv_bias
+ConvBiasReLU = conv_bias_relu
+ConvBiasMaskReLU = conv_bias_mask_relu
+ConvFrozenScaleBiasReLU = conv_frozen_scale_bias_relu
+
+__all__ = ["ConvBias", "ConvBiasMaskReLU", "ConvBiasReLU",
+           "ConvFrozenScaleBiasReLU", "conv_bias", "conv_bias_mask_relu",
+           "conv_bias_relu", "conv_frozen_scale_bias_relu"]

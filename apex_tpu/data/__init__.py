@@ -1,0 +1,16 @@
+"""apex_tpu.data — native-backed input pipeline.
+
+The reference leaves data loading to torch ``DataLoader``/DALI (C++
+under the hood); this package is the TPU rebuild's equivalent tier: the
+hot path (epoch shuffle, batch row gather, BERT MLM masking) runs in C
+(``csrc/dataloader.c`` via ctypes, same build scheme as
+:mod:`apex_tpu._native`), and a background-thread prefetcher overlaps
+host batch assembly with device steps. Numpy fallbacks keep the package
+working without a compiler.
+"""
+
+from apex_tpu.data.loader import (  # noqa: F401
+    CausalLMBatchLoader,
+    MLMBatchLoader,
+    native_available,
+)

@@ -1,0 +1,99 @@
+/* Host-side data-loader hot path: epoch shuffling, batch row gather,
+ * and BERT-style MLM masking over tokenized corpora.
+ *
+ * The reference ecosystem leaves input pipelines to DALI/torch
+ * DataLoader (C++ under the hood); this is the equivalent native tier
+ * for the TPU rebuild: branch-light C over preallocated numpy buffers,
+ * driven through ctypes (no pybind11 in this toolchain), with a
+ * background-thread prefetcher on the Python side overlapping batch
+ * assembly with device steps.
+ *
+ * RNG: SplitMix64 seeding + xorshift64* streams, one stream per call —
+ * deterministic for a given (seed, call) pair regardless of batch
+ * order, so shuffles and masks are reproducible across runs.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+static inline uint64_t splitmix64(uint64_t *s) {
+    uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+static inline uint64_t xorshift64s(uint64_t *s) {
+    uint64_t x = *s;
+    x ^= x >> 12;
+    x ^= x << 25;
+    x ^= x >> 27;
+    *s = x;
+    return x * 0x2545F4914F6CDD1DULL;
+}
+
+/* Unbiased bounded draw (Lemire): uniform in [0, bound). */
+static inline uint64_t bounded(uint64_t *s, uint64_t bound) {
+    if (bound <= 1) return 0;
+    __uint128_t m = (__uint128_t)xorshift64s(s) * bound;
+    return (uint64_t)(m >> 64);
+}
+
+/* Fill idx with 0..n-1 shuffled (Fisher-Yates). */
+void apex_shuffle_indices(uint64_t *idx, size_t n, uint64_t seed) {
+    uint64_t st = seed ? seed : 1;
+    uint64_t rng = splitmix64(&st);
+    if (!rng) rng = 1;
+    for (size_t i = 0; i < n; i++) idx[i] = i;
+    for (size_t i = n; i > 1; i--) {
+        uint64_t j = bounded(&rng, i);
+        uint64_t t = idx[i - 1];
+        idx[i - 1] = idx[j];
+        idx[j] = t;
+    }
+}
+
+/* Gather rows: out[r] = corpus[idx[r]] for r in [0, n_rows). */
+void apex_gather_rows(const int32_t *corpus, size_t row_len,
+                      const uint64_t *idx, size_t n_rows, int32_t *out) {
+    for (size_t r = 0; r < n_rows; r++)
+        memcpy(out + r * row_len, corpus + idx[r] * row_len,
+               row_len * sizeof(int32_t));
+}
+
+/* BERT MLM masking over a flat token buffer of length n.
+ *
+ * For each position whose token is not in special[0..n_special):
+ *   with probability prob_q16/65536: labels[i] = tokens[i], then
+ *     80%: ids[i] = mask_id; 10%: ids[i] = uniform random token;
+ *     10%: ids[i] = tokens[i] (unchanged).
+ * Every other position: ids[i] = tokens[i], labels[i] = -1.
+ */
+void apex_mlm_mask(const int32_t *tokens, int32_t *ids, int32_t *labels,
+                   size_t n, int32_t vocab_size, int32_t mask_id,
+                   const int32_t *special, size_t n_special,
+                   uint32_t prob_q16, uint64_t seed) {
+    uint64_t st = seed ? seed : 1;
+    uint64_t rng = splitmix64(&st);
+    if (!rng) rng = 1;
+    for (size_t i = 0; i < n; i++) {
+        int32_t tok = tokens[i];
+        ids[i] = tok;
+        labels[i] = -1;
+        int is_special = 0;
+        for (size_t k = 0; k < n_special; k++)
+            if (tok == special[k]) { is_special = 1; break; }
+        if (is_special) continue;
+        uint64_t r = xorshift64s(&rng);
+        if ((uint32_t)(r & 0xFFFF) < prob_q16) {
+            labels[i] = tok;
+            uint32_t kind = (uint32_t)((r >> 16) % 10); /* 0-7 mask, 8 rnd */
+            if (kind < 8)
+                ids[i] = mask_id;
+            else if (kind == 8)
+                ids[i] = (int32_t)bounded(&rng, (uint64_t)vocab_size);
+            /* kind == 9: keep original */
+        }
+    }
+}

@@ -42,6 +42,23 @@ def synthetic_batch(cfg, batch, seq, seed):
             jnp.asarray(mask))
 
 
+def make_loader(cfg, batch, seq, steps):
+    """Real input pipeline over a synthetic corpus: C-path shuffle +
+    row gather + MLM masking with background prefetch
+    (apex_tpu.data.MLMBatchLoader)."""
+    from apex_tpu.data import MLMBatchLoader
+
+    # fixed-size corpus cycled over epochs (set_epoch reshuffles+remasks)
+    # — constant host memory no matter how many steps
+    n_rows = min(max(batch * steps, batch), max(batch, 4096))
+    rng = np.random.RandomState(1234)
+    corpus = rng.randint(5, cfg.vocab_size, (n_rows, seq)).astype(np.int32)
+    corpus[:, 0] = 1  # [CLS]-slot analog, never masked
+    return MLMBatchLoader(corpus, batch_size=batch,
+                          vocab_size=cfg.vocab_size, mask_id=4,
+                          special_ids=[0, 1, 2, 3, 4], prefetch=2)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="tiny",
@@ -110,9 +127,27 @@ def main():
     # where it can without the annotation
     step_fn = jax.jit(step_fn)
 
+    loader = make_loader(cfg, args.batch_size, args.seq, args.steps)
+    nsp_rng = np.random.RandomState(99)
+    batches = iter(loader)
+
+    def next_batch():
+        nonlocal batches
+        try:
+            return next(batches)
+        except StopIteration:  # epoch boundary: reshuffle + remask
+            loader.set_epoch(loader.epoch + 1)
+            batches = iter(loader)
+            return next(batches)
+
     t0 = time.perf_counter()
     for i in range(args.steps):
-        b = synthetic_batch(cfg, args.batch_size, args.seq, i)
+        # prefetched host batch (C-path gather + MLM mask); NSP labels
+        # are synthetic — the corpus has no sentence-pair structure
+        ids_np, labels_np = next_batch()
+        b = (jnp.asarray(ids_np), jnp.asarray(labels_np),
+             jnp.asarray(nsp_rng.randint(0, 2, (args.batch_size,))),
+             jnp.ones((args.batch_size, args.seq), jnp.int32))
         prev = scaler_state
         params, opt_state, scaler_state, loss = step_fn(
             params, opt_state, scaler_state, *b)

@@ -221,3 +221,41 @@ def test_promote_table_matches_jnp_promotion():
         if out.dtype == jnp.bool_:
             continue  # comparisons return bool; promotion happened inside
         assert out.dtype == jnp.float32, (mod_name, fn_name, out.dtype)
+
+
+def test_module_level_amp_surface():
+    """Reference parity: amp.scale_loss / amp.state_dict /
+    amp.load_state_dict / amp.master_params as MODULE-level functions
+    bound to the most recent initialize() (apex keeps the same global
+    handle in _amp_state)."""
+    import jax
+    import jax.numpy as jnp
+
+    import apex_tpu.amp as amp
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    params, opt, handle = amp.initialize(
+        params, FusedAdam(lr=1e-3), opt_level="O2", verbosity=0)
+    ost = opt.init(params)
+
+    # master_params iterates the fp32 masters (O2 => present)
+    masters = list(amp.master_params(ost))
+    assert len(masters) == 1 and masters[0].dtype == jnp.float32
+
+    # state_dict round-trips through the module-level functions
+    sd = amp.state_dict()
+    assert "loss_scaler0" in sd
+    amp.load_state_dict(sd)
+
+    # scale_loss delegates to the handle's scaler (functional: returns
+    # the scaled loss, the enter half of the reference context manager)
+    sst = handle.init_state()
+    scaled = amp.scale_loss(jnp.float32(2.0), sst)
+    assert float(scaled) == 2.0 * float(sst.loss_scale)
+
+    # O1: no masters
+    p1, opt1, _ = amp.initialize(
+        {"w": jnp.ones((2,), jnp.float32)}, FusedAdam(lr=1e-3),
+        opt_level="O1", verbosity=0)
+    assert list(amp.master_params(opt1.init(p1))) == []

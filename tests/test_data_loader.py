@@ -1,0 +1,139 @@
+"""apex_tpu.data tests: native C hot path vs contracts (shapes, masking
+ratios, determinism, epoch reshuffle, prefetch ordering)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.data import CausalLMBatchLoader, MLMBatchLoader, native_available
+from apex_tpu.data.loader import _gather_rows, _mlm_mask, _shuffled_indices
+
+
+def test_native_builds():
+    # the toolchain in CI has cc; if this fails the numpy fallback is
+    # covering everything, which the other tests would still validate
+    assert native_available()
+
+
+def test_shuffle_is_permutation_and_deterministic():
+    a = _shuffled_indices(1000, seed=42)
+    b = _shuffled_indices(1000, seed=42)
+    c = _shuffled_indices(1000, seed=43)
+    np.testing.assert_array_equal(np.sort(a), np.arange(1000))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert not np.array_equal(a, np.arange(1000))  # actually shuffled
+
+
+def test_gather_rows_matches_numpy():
+    corpus = np.arange(50 * 7, dtype=np.int32).reshape(50, 7)
+    idx = np.asarray([3, 0, 49, 17], np.uint64)
+    np.testing.assert_array_equal(_gather_rows(corpus, idx),
+                                  corpus[idx.astype(int)])
+
+
+def test_mlm_mask_contract():
+    rng = np.random.RandomState(0)
+    vocab, mask_id = 1000, 4
+    special = np.asarray([0, 1, 2, 3, 4], np.int32)
+    tokens = rng.randint(5, vocab, (64, 128)).astype(np.int32)
+    tokens[:, 0] = 1   # [CLS]-like
+    tokens[:, -1] = 2  # [SEP]-like
+    ids, labels = _mlm_mask(tokens, vocab, mask_id, special, 0.15, seed=7)
+
+    # unmasked positions: ids unchanged, label -1
+    un = labels == -1
+    np.testing.assert_array_equal(ids[un], tokens[un])
+    # masked positions: label holds the original token
+    np.testing.assert_array_equal(labels[~un], tokens[~un])
+    # special positions are never selected
+    assert (labels[:, 0] == -1).all() and (labels[:, -1] == -1).all()
+    # selection rate ~15%
+    frac = (~un).mean() * 128 / 126  # exclude the 2 special slots
+    assert 0.12 < frac < 0.18, frac
+    # of selected: ~80% [MASK], ~10% random, ~10% unchanged
+    sel_ids, sel_orig = ids[~un], tokens[~un]
+    m = (sel_ids == mask_id).mean()
+    keep = (sel_ids == sel_orig).mean()
+    assert 0.7 < m < 0.9, m
+    assert 0.05 < keep < 0.17, keep
+    # deterministic per seed
+    ids2, labels2 = _mlm_mask(tokens, vocab, mask_id, special, 0.15, seed=7)
+    np.testing.assert_array_equal(ids, ids2)
+    np.testing.assert_array_equal(labels, labels2)
+    ids3, _ = _mlm_mask(tokens, vocab, mask_id, special, 0.15, seed=8)
+    assert not np.array_equal(ids, ids3)
+
+
+def test_mlm_loader_epochs_and_shapes():
+    rng = np.random.RandomState(1)
+    corpus = rng.randint(5, 500, (40, 16)).astype(np.int32)
+    loader = MLMBatchLoader(corpus, batch_size=8, vocab_size=500, mask_id=3,
+                            special_ids=[0, 1, 2, 3], seed=5)
+    assert len(loader) == 5
+    batches = list(loader)
+    assert len(batches) == 5
+    for ids, labels in batches:
+        assert ids.shape == (8, 16) and ids.dtype == np.int32
+        assert labels.shape == (8, 16)
+    # same epoch re-iterated: identical stream (reproducibility)
+    again = list(loader)
+    for (a, la), (b, lb) in zip(batches, again):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+    # new epoch: different shuffle
+    loader.set_epoch(1)
+    third = list(loader)
+    assert any(not np.array_equal(a, b)
+               for (a, _), (b, _) in zip(batches, third))
+    # every corpus row appears exactly once per epoch (modulo masking):
+    # collect unmasked positions to reconstruct rows is overkill — check
+    # the row multiset via label-restored tokens
+    restored = np.concatenate(
+        [np.where(l == -1, i, l) for i, l in third])  # (40, 16)
+    assert (np.sort(restored.sum(1)) == np.sort(corpus.sum(1))).all()
+
+
+def test_causal_loader_covers_corpus():
+    corpus = np.arange(12 * 4, dtype=np.int32).reshape(12, 4)
+    loader = CausalLMBatchLoader(corpus, batch_size=4, seed=9)
+    got = np.concatenate(list(loader))
+    assert got.shape == (12, 4)
+    np.testing.assert_array_equal(
+        np.sort(got.reshape(-1)), np.sort(corpus.reshape(-1)))
+
+
+def test_drop_last_validation():
+    corpus = np.zeros((10, 4), np.int32)
+    with pytest.raises(NotImplementedError):
+        CausalLMBatchLoader(corpus, batch_size=3, drop_last=False)
+    loader = CausalLMBatchLoader(corpus, batch_size=3)  # drop_last
+    assert len(loader) == 3
+
+
+def test_prefetch_propagates_worker_exceptions():
+    """A batch-assembly error must crash the consumer, not hang it."""
+    from apex_tpu.data.loader import _PrefetchIterator
+
+    def bad_batch(i):
+        if i == 2:
+            raise RuntimeError("corrupt shard")
+        return i
+
+    it = _PrefetchIterator(bad_batch, n_batches=5, depth=1)
+    got = []
+    with pytest.raises(RuntimeError, match="corrupt shard"):
+        for x in it:
+            got.append(x)
+    assert got == [0, 1]
+
+
+def test_prefetch_early_abandon_releases_worker():
+    """Breaking out of iteration must not strand the worker thread."""
+    from apex_tpu.data.loader import _PrefetchIterator
+
+    it = _PrefetchIterator(lambda i: i, n_batches=1000, depth=1)
+    assert next(it) == 0
+    thread = it._thread
+    it.close()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
