@@ -1,0 +1,85 @@
+"""On-hardware smoke for the round-2 late additions: the MoE layer
+(routing einsums + grouped expert GEMMs compile and train on the real
+chip), the dots remat policy, and the native data loader feeding an
+actual device step. Same contract as the other smoke files: real
+kernels, auto-skipped off-TPU by conftest."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_moe_gpt_train_step_on_chip():
+    from apex_tpu.models.gpt import (
+        GPTConfig,
+        GPTLMHeadModel,
+        lm_loss,
+        moe_losses_total,
+    )
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = GPTConfig.tiny(num_experts=4, moe_top_k=2, dropout=0.0,
+                         fused_kernels=True, remat=False,
+                         hidden_size=128, num_heads=4)
+    model = GPTLMHeadModel(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (4, 64)))
+    params = {"params": model.init(jax.random.PRNGKey(0), ids)["params"]}
+    opt = FusedAdam(lr=1e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(params, ost):
+        def loss_fn(p):
+            logits, col = model.apply(p, ids, mutable=("losses",))
+            return lm_loss(logits, ids) + moe_losses_total(col)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        p2, o2 = opt.step(g, ost, params)
+        return p2, o2, loss
+
+    losses = []
+    for _ in range(5):
+        params, ost, loss = step(params, ost)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_dots_remat_policy_on_chip():
+    from apex_tpu.models import BertConfig, BertForPreTraining
+
+    cfg = BertConfig.tiny(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                          attention_dropout=0.0, remat=True,
+                          remat_policy="dots")
+    model = BertForPreTraining(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 64)))
+    mask = jnp.ones_like(ids)
+    params = model.init(jax.random.PRNGKey(0), ids, None, mask)
+
+    def loss(p):
+        mlm, nsp = model.apply(p, ids, None, mask)
+        return mlm.astype(jnp.float32).mean() + nsp.astype(jnp.float32).mean()
+
+    val, g = jax.jit(jax.value_and_grad(loss))(params)
+    jax.block_until_ready(g)
+    assert np.isfinite(float(val))
+
+
+def test_data_loader_feeds_device_step():
+    from apex_tpu.data import MLMBatchLoader, native_available
+
+    assert native_available()  # C path must build on the bench machine
+    rng = np.random.RandomState(3)
+    corpus = rng.randint(5, 500, (64, 32)).astype(np.int32)
+    loader = MLMBatchLoader(corpus, batch_size=16, vocab_size=500,
+                            mask_id=4, special_ids=[0, 1, 2, 3, 4])
+
+    @jax.jit
+    def masked_count(ids, labels):
+        return jnp.sum(labels >= 0), jnp.sum(ids)
+
+    total = 0
+    for ids_np, labels_np in loader:
+        n, _ = masked_count(jnp.asarray(ids_np), jnp.asarray(labels_np))
+        total += int(n)
+    assert total > 0  # some positions masked, device consumed every batch
