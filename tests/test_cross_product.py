@@ -1,0 +1,97 @@
+"""L1 cross-product analog (reference: ``tests/L1/cross_product/run.sh``
++ ``compare.py`` (U), SURVEY.md §4): sweep opt_level x loss_scale over
+the same model/data/seed and diff the loss curves between configs. The
+reference asserts the mixed-precision recipes track the fp32 recipe; so
+does this — O0 is the anchor, every other config must follow its curve
+within a bf16-sized tolerance and reach the same converged loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.amp as amp
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.optimizers import FusedAdam
+
+import flax.linen as nn
+
+STEPS = 40
+
+
+class Net(nn.Module):
+    """Small net WITH a norm layer so keep_batchnorm_fp32 has teeth."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32, param_dtype=jnp.float32)(x)
+        x = FusedLayerNorm(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(4, param_dtype=jnp.float32)(x)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16) * 3
+    xs = np.concatenate([c + rng.randn(32, 16) for c in centers])
+    ys = np.repeat(np.arange(4), 32)
+    return jnp.asarray(xs, jnp.float32), jnp.asarray(ys)
+
+
+def _curve(opt_level, loss_scale=None, keep_batchnorm_fp32=None):
+    xs, ys = _data()
+    model = Net()
+    params = model.init(jax.random.PRNGKey(1), xs)["params"]
+    kw = {}
+    if loss_scale is not None:
+        kw["loss_scale"] = loss_scale
+    if keep_batchnorm_fp32 is not None:
+        kw["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+    params, opt, handle = amp.initialize(
+        params, FusedAdam(lr=1e-2), opt_level=opt_level, verbosity=0, **kw)
+    ost = opt.init(params)
+    sst = handle.init_state()
+
+    @jax.jit
+    def step(params, ost, sst):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, xs).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(lp, ys[:, None], 1))
+
+        (loss, found), grads = handle.value_and_grad(loss_fn, sst)(params)
+        p2, o2 = opt.step(grads, ost, params, skip_if=found)
+        return p2, o2, handle.update_scale(sst, found), loss
+
+    curve = []
+    for _ in range(STEPS):
+        params, ost, sst, loss = step(params, ost, sst)
+        curve.append(float(loss))
+    return np.asarray(curve)
+
+
+@pytest.fixture(scope="module")
+def anchor():
+    return _curve("O0")
+
+
+CONFIGS = [
+    ("O1", None, None),
+    ("O1", 128.0, None),
+    ("O2", None, None),
+    ("O2", 128.0, None),
+    ("O2", None, False),   # cast norms too
+    ("O3", None, None),
+    ("O3", None, True),    # O3 + fp32 norms (the documented O3 tweak)
+]
+
+
+@pytest.mark.parametrize("opt_level,loss_scale,keep_bn", CONFIGS)
+def test_curves_track_fp32_anchor(anchor, opt_level, loss_scale, keep_bn):
+    curve = _curve(opt_level, loss_scale, keep_bn)
+    assert np.all(np.isfinite(curve))
+    # compare.py contract: trajectories agree within mixed-precision
+    # noise at every step, and converge to the anchor's level
+    np.testing.assert_allclose(curve, anchor, atol=0.08)
+    assert curve[-1] < anchor[0] * 0.2  # actually trained
+    assert abs(curve[-1] - anchor[-1]) < 0.05
