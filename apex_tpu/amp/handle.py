@@ -79,6 +79,7 @@ class AmpHandle:
                 "loss_scale": float(st.loss_scale),
                 "unskipped": int(st.unskipped),
                 "steps_skipped": int(st.steps_skipped),
+                "hysteresis": int(st.hysteresis),
             }
         return out
 
@@ -89,4 +90,7 @@ class AmpHandle:
                 loss_scale=jnp.asarray(entry["loss_scale"], jnp.float32),
                 unskipped=jnp.asarray(entry["unskipped"], jnp.int32),
                 steps_skipped=jnp.asarray(entry.get("steps_skipped", 0), jnp.int32),
+                hysteresis=jnp.asarray(
+                    entry.get("hysteresis", self.scalers[i].hysteresis),
+                    jnp.int32),
             )

@@ -39,6 +39,10 @@ class ScalerState(NamedTuple):
     loss_scale: jnp.ndarray  # f32 scalar
     unskipped: jnp.ndarray   # i32 scalar: consecutive overflow-free steps
     steps_skipped: jnp.ndarray  # i32 scalar: lifetime skipped-step count
+    # remaining consecutive-overflow tolerance before the scale backs off
+    # (reference: csrc/update_scale_hysteresis.cu (U) — with the default
+    # hysteresis of 1 every overflow backs off, the core-amp behavior)
+    hysteresis: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +63,10 @@ class LossScaler:
     min_loss_scale: Optional[float] = None
     max_loss_scale: float = 2.0 ** 24
     loss_id: int = 0  # apex supports num_losses scalers, each with an id
+    # back off only after this many consecutive overflow steps (each still
+    # skipped); 1 = reference core-amp behavior. Mirrors the kernel-side
+    # hysteresis of ``amp_C.update_scale_hysteresis`` (U).
+    hysteresis: int = 1
 
     @property
     def dynamic(self) -> bool:
@@ -70,6 +78,7 @@ class LossScaler:
             loss_scale=jnp.asarray(scale, jnp.float32),
             unskipped=jnp.asarray(0, jnp.int32),
             steps_skipped=jnp.asarray(0, jnp.int32),
+            hysteresis=jnp.asarray(self.hysteresis, jnp.int32),
         )
 
     # -- step pieces ------------------------------------------------------
@@ -100,7 +109,12 @@ class LossScaler:
             return state._replace(
                 steps_skipped=state.steps_skipped + found_inf.astype(jnp.int32)
             )
-        # overflow branch
+        # overflow branch: decrement the hysteresis tolerance; only when it
+        # is used up does the scale actually back off (hysteresis=1, the
+        # default, backs off on every overflow — the reference core-amp
+        # contract; >1 mirrors amp_C.update_scale_hysteresis (U))
+        hys = jnp.asarray(state.hysteresis, jnp.int32) - found_inf.astype(jnp.int32)
+        back_off_now = jnp.logical_and(found_inf, hys <= 0)
         floor = self.min_loss_scale if self.min_loss_scale is not None else 0.0
         backed_off = jnp.maximum(state.loss_scale / self.scale_factor, floor)
         # clean branch
@@ -111,10 +125,22 @@ class LossScaler:
             jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale),
             state.loss_scale,
         )
+        reset_hys = jnp.asarray(self.hysteresis, jnp.int32)
         new = ScalerState(
-            loss_scale=jnp.where(found_inf, backed_off, grown),
+            loss_scale=jnp.where(
+                found_inf, jnp.where(back_off_now, backed_off, state.loss_scale),
+                grown),
             unskipped=jnp.where(found_inf, 0, jnp.where(grow, 0, unskipped)).astype(jnp.int32),
             steps_skipped=state.steps_skipped + found_inf.astype(jnp.int32),
+            # the tolerance only replenishes on a growth event (reference
+            # tracker semantics): once depleted, every further consecutive
+            # overflow backs off, so recovery from a far-too-high scale is
+            # not slowed by hysteresis. Clamp at 0 to keep the <=0 test
+            # stable instead of drifting negative.
+            hysteresis=jnp.where(
+                found_inf, jnp.maximum(hys, 0),
+                jnp.where(grow, reset_hys, state.hysteresis)
+            ).astype(jnp.int32),
         )
         if _amp_state.ingraph_logging_enabled() and _amp_state.get_verbosity() >= 1:
             # The reference's contractual overflow line. Emitted via a host
@@ -122,16 +148,26 @@ class LossScaler:
             # plugin rejects host send/recv) — hence the capability gate in
             # ingraph_logging_enabled(); use amp.set_ingraph_logging(True)
             # to force it on runtimes known to support callbacks.
+            prefix = ("Gradient overflow.  Skipping step, loss scaler "
+                      + str(self.loss_id))
+
+            def _log_reduce(s):
+                jax.debug.print(prefix + " reducing loss scale to {scale}",
+                                scale=s)
+
+            def _log_hold(s):
+                # hysteresis held: skipped, but the scale did NOT change —
+                # distinct wording so grep/parse consumers of the
+                # "reducing" line never record a phantom reduction
+                jax.debug.print(prefix + " hysteresis holding loss scale "
+                                "at {scale}", scale=s)
+
             jax.lax.cond(
                 found_inf,
-                lambda s: jax.debug.print(
-                    "Gradient overflow.  Skipping step, loss scaler "
-                    + str(self.loss_id)
-                    + " reducing loss scale to {scale}",
-                    scale=s,
-                ),
+                lambda s: jax.lax.cond(back_off_now, _log_reduce,
+                                       _log_hold, s),
                 lambda s: None,
-                backed_off,
+                new.loss_scale,
             )
         return new
 
@@ -213,11 +249,22 @@ class LossScaler:
                            and _amp_state.ingraph_logging_enabled())
         if not ingraph_already:
             if self.dynamic:
-                _amp_state.maybe_print(
-                    "Gradient overflow.  Skipping step, loss scaler "
-                    f"{self.loss_id} reducing loss scale to "
-                    f"{float(new_state.loss_scale)}"
-                )
+                reduced = (float(new_state.loss_scale)
+                           < float(prev_state.loss_scale))
+                if reduced:
+                    _amp_state.maybe_print(
+                        "Gradient overflow.  Skipping step, loss scaler "
+                        f"{self.loss_id} reducing loss scale to "
+                        f"{float(new_state.loss_scale)}"
+                    )
+                else:
+                    # hysteresis held the scale: same skip event, distinct
+                    # wording (no phantom reduction for grep consumers)
+                    _amp_state.maybe_print(
+                        "Gradient overflow.  Skipping step, loss scaler "
+                        f"{self.loss_id} hysteresis holding loss scale at "
+                        f"{float(new_state.loss_scale)}"
+                    )
             else:
                 _amp_state.maybe_print(
                     "Gradient overflow.  Skipping step, loss scaler "

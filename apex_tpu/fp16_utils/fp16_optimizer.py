@@ -100,6 +100,7 @@ class FP16_Optimizer:
                 "loss_scale": state.scaler.loss_scale,
                 "unskipped": state.scaler.unskipped,
                 "steps_skipped": state.scaler.steps_skipped,
+                "hysteresis": state.scaler.hysteresis,
             },
             "optimizer_state": state.inner,
         }
@@ -114,5 +115,9 @@ class FP16_Optimizer:
                                       jnp.int32),
                 steps_skipped=jnp.asarray(
                     sd["loss_scaler"]["steps_skipped"], jnp.int32),
+                hysteresis=jnp.asarray(
+                    sd["loss_scaler"].get("hysteresis",
+                                          self._scaler.hysteresis),
+                    jnp.int32),
             ),
         )

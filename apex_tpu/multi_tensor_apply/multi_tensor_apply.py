@@ -25,13 +25,14 @@ class MultiTensorApply:
     def __init__(self, chunk_size: int = 2048 * 32):
         self.chunk_size = chunk_size
 
-    def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args, **kwargs):
         """Apply ``op`` over parallel ``tensor_lists``.
 
         ``noop_flag_buffer`` is a traced bool scalar or None (the
         functional stand-in for the reference's device int buffer).
         """
-        return op(self.chunk_size, noop_flag_buffer, tensor_lists, *args)
+        return op(self.chunk_size, noop_flag_buffer, tensor_lists,
+                  *args, **kwargs)
 
 
 multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -81,12 +81,11 @@ def _apply_noop(noop_flag, new_lists, old_lists):
 # scale / axpby / l2norm  (csrc/multi_tensor_{scale,axpby,l2norm}.cu)
 # ---------------------------------------------------------------------------
 
-def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
-    """out = in * scale, detecting non-finite values in one fused pass.
-
-    Reference: ``amp_C.multi_tensor_scale`` — the hot op of loss unscaling
-    (SURVEY.md §3.2). Returns ``(out_list, noop_flag_out)``.
-    """
+def _scaled_with_flag(noop_flag, tensor_lists, scale):
+    """Shared core of the scale-family ops: fp32-scale the first list,
+    detect non-finite results, fold into the incoming noop flag, and
+    revert outputs to the inputs when that flag was already set (the CUDA
+    kernels' early-exit). Returns ``(scaled_f32, outs, flag_out)``."""
     _check_parallel(tensor_lists)
     src = tensor_lists[0]
     out_dtypes = [t.dtype for t in tensor_lists[-1]]
@@ -97,6 +96,16 @@ def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
     if noop_flag is not None:
         outs = [jnp.where(noop_flag, s.astype(d), o)
                 for s, o, d in zip(src, outs, out_dtypes)]
+    return scaled, outs, flag_out
+
+
+def multi_tensor_scale(chunk_size, noop_flag, tensor_lists, scale):
+    """out = in * scale, detecting non-finite values in one fused pass.
+
+    Reference: ``amp_C.multi_tensor_scale`` — the hot op of loss unscaling
+    (SURVEY.md §3.2). Returns ``(out_list, noop_flag_out)``.
+    """
+    _, outs, flag_out = _scaled_with_flag(noop_flag, tensor_lists, scale)
     return outs, flag_out
 
 
@@ -127,6 +136,32 @@ def multi_tensor_l2norm(chunk_size, noop_flag, tensor_lists, per_tensor=False):
     if per_tensor:
         return global_norm, jnp.sqrt(sq)
     return global_norm, None
+
+
+def multi_tensor_l2norm_scale(chunk_size, noop_flag, tensor_lists, scale,
+                              per_tensor=False):
+    """Fused scale + L2 norm: ``out = in * scale`` while reducing the L2
+    norms of the *scaled* values in the same pass
+    (``amp_C.multi_tensor_l2norm_scale``, reference
+    ``csrc/multi_tensor_l2norm_scale_kernel.cu`` (U) — used by the
+    distributed LAMB path to unscale gradients and get their norms with
+    one read of HBM; here the scale, square, and sum fuse under XLA the
+    same way).
+
+    Returns ``(out_list, global_norm, per_tensor_norms_or_None,
+    noop_flag_out)``.
+    """
+    scaled, outs, flag_out = _scaled_with_flag(noop_flag, tensor_lists, scale)
+    sq = jnp.stack([jnp.sum(jnp.square(s)) for s in scaled]) if scaled else (
+        jnp.zeros((0,), jnp.float32))
+    if noop_flag is not None:
+        # early-exit contract: under a set incoming flag the CUDA kernel
+        # never writes its zero-initialized norm buffer, so the norms must
+        # report 0 — not the (possibly non-finite) skipped computation
+        sq = jnp.where(noop_flag, jnp.zeros_like(sq), sq)
+    global_norm = jnp.sqrt(jnp.sum(sq))
+    per = jnp.sqrt(sq) if per_tensor else None
+    return outs, global_norm, per, flag_out
 
 
 # ---------------------------------------------------------------------------

@@ -194,21 +194,5 @@ def is_pipeline_last_stage(ignore_virtual: bool = True):
     return get_pipeline_model_parallel_rank() == _PP_SIZE - 1
 
 
-# vocab range helper used by VocabParallelEmbedding / parallel CE
-class VocabUtility:
-    """Reference: ``tensor_parallel/utils.py:VocabUtility``."""
-
-    @staticmethod
-    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size, rank):
-        start = rank * per_partition_vocab_size
-        return start, start + per_partition_vocab_size
-
-    @staticmethod
-    def vocab_range_from_global_vocab_size(global_vocab_size, rank, world_size):
-        if global_vocab_size % world_size != 0:
-            raise ValueError(
-                f"vocab size ({global_vocab_size}) must be divisible by "
-                f"tensor parallel size ({world_size})"
-            )
-        per = global_vocab_size // world_size
-        return VocabUtility.vocab_range_from_per_partition_vocab_size(per, rank)
+# The vocab-range helper lives in tensor_parallel.utils (VocabUtility),
+# mirroring the reference layout.

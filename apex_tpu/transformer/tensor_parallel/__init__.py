@@ -30,3 +30,9 @@ from apex_tpu.transformer.tensor_parallel.main_grad import (  # noqa: F401,E402
     init_main_grads,
     reset_main_grads,
 )
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401,E402
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)

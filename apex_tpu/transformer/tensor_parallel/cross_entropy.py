@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
 
 def _axis():
@@ -52,7 +53,8 @@ def _ce_fwd_impl(logits, target, label_smoothing):
     local_sumexp = jnp.sum(exp, axis=-1)
     global_sumexp = jax.lax.psum(local_sumexp, _axis())
 
-    start = rank * per
+    start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        per, rank, tp)
     local_t = target - start
     in_range = (local_t >= 0) & (local_t < per)
     safe_t = jnp.where(in_range, local_t, 0)

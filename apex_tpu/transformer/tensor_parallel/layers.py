@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_along_first_dim,
@@ -233,7 +234,8 @@ class VocabParallelEmbedding(nn.Module):
             (per, self.embedding_dim),
             self.params_dtype,
         )
-        start = rank * per
+        start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, tp)
         local_ids = ids - start
         in_range = (local_ids >= 0) & (local_ids < per)
         safe_ids = jnp.where(in_range, local_ids, 0)

@@ -1,0 +1,71 @@
+"""Tensor-parallel sizing helpers.
+
+Rebuild of ``apex/transformer/tensor_parallel/utils.py`` (U) and the
+``ensure_divisibility``/``divide`` helpers of ``apex/transformer/utils.py``
+(U) — the small arithmetic surface Megatron-style code builds shard
+shapes from. Kept dependency-free so both model code and tests can use
+it; everything works with Python ints *or* traced rank values (the JAX
+analog of the reference's ``torch.distributed.get_rank()`` ints).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "ensure_divisibility",
+    "divide",
+    "split_tensor_along_last_dim",
+    "VocabUtility",
+]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """Raise unless ``denominator`` divides ``numerator`` exactly."""
+    if numerator % denominator != 0:
+        raise ValueError(
+            f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact integer division (raises on remainder)."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int) -> Sequence:
+    """Split a tensor into ``num_partitions`` equal chunks along its last
+    dimension (reference signature also takes ``contiguous_split_chunks``;
+    XLA arrays have no stride/contiguity notion, so every chunk here is
+    already "contiguous")."""
+    last = tensor.shape[-1]
+    divide(last, num_partitions)  # validates
+    return jnp.split(tensor, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Shard-range arithmetic for a vocab dimension partitioned over the
+    tensor-parallel axis: ranges are [first, last) index pairs.
+
+    Reference: ``apex.transformer.tensor_parallel.utils.VocabUtility`` —
+    used by ``VocabParallelEmbedding`` and the vocab-parallel cross
+    entropy to map global token ids onto a rank's local rows. ``rank``
+    may be a Python int or a traced ``jax.lax.axis_index`` value.
+    """
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+            global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)

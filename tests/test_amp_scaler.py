@@ -184,3 +184,42 @@ def test_mnist_style_smoke_recovers_from_overflow():
     assert int(st.steps_skipped) == 1
     assert float(st.loss_scale) == 2.0 ** 15
     assert losses[-1] < losses[0]
+
+
+def test_hysteresis_delays_backoff():
+    """hysteresis=N: the scale holds through N-1 consecutive overflows
+    (each step still skipped) and backs off on the Nth
+    (amp_C.update_scale_hysteresis semantics)."""
+    scaler = LossScaler(hysteresis=3)
+    st = scaler.init()
+    t = jnp.asarray(True)
+    st = scaler.update(st, t)
+    st = scaler.update(st, t)
+    assert float(st.loss_scale) == 2.0 ** 16  # tolerance not yet used up
+    assert int(st.steps_skipped) == 2         # but both steps skipped
+    st = scaler.update(st, t)
+    assert float(st.loss_scale) == 2.0 ** 15  # third overflow backs off
+    # tolerance does NOT replenish on back-off (reference tracker
+    # semantics): while the streak continues every overflow backs off,
+    # so recovery from a far-too-high initial scale is not slowed
+    st = scaler.update(st, t)
+    assert float(st.loss_scale) == 2.0 ** 14
+
+
+def test_hysteresis_replenishes_on_growth():
+    scaler = LossScaler(hysteresis=2, scale_seq_len=2)
+    st = scaler.init()
+    st = scaler.update(st, jnp.asarray(True))   # tolerance 2 -> 1
+    assert int(st.hysteresis) == 1
+    st = scaler.update(st, jnp.asarray(False))
+    st = scaler.update(st, jnp.asarray(False))  # growth event
+    assert float(st.loss_scale) == 2.0 ** 17
+    assert int(st.hysteresis) == 2              # replenished
+
+
+def test_default_hysteresis_matches_reference_backoff():
+    """hysteresis=1 (default) must reproduce the core-amp contract
+    exactly: every overflow halves the scale."""
+    st = LossScaler().init()
+    st = LossScaler().update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 2.0 ** 15

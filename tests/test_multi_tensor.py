@@ -158,3 +158,22 @@ def test_jit_single_fusion():
     outs, flag = f(xs)
     assert not bool(flag)
     np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(xs[0]) * 2, rtol=1e-6)
+
+
+def test_l2norm_scale_fused():
+    """multi_tensor_l2norm_scale: out = in*scale with norms of the scaled
+    values from the same pass (csrc/multi_tensor_l2norm_scale_kernel.cu)."""
+    xs = [jnp.asarray([3.0, 4.0]), jnp.asarray([12.0])]
+    outs, gnorm, per, flag = multi_tensor_applier(
+        mt.multi_tensor_l2norm_scale, None,
+        [xs, [jnp.zeros_like(x) for x in xs]], 0.5, per_tensor=True)
+    assert jnp.allclose(outs[0], jnp.asarray([1.5, 2.0]))
+    assert jnp.allclose(per, jnp.asarray([2.5, 6.0]))
+    assert jnp.allclose(gnorm, 6.5)  # sqrt(2.5^2 + 6^2)
+    assert not bool(flag)
+
+    # inf detection + incoming noop flag passthrough
+    bad = [jnp.asarray([jnp.inf])]
+    _, _, _, flag2 = multi_tensor_applier(
+        mt.multi_tensor_l2norm_scale, None, [bad, bad], 1.0)
+    assert bool(flag2)

@@ -400,3 +400,35 @@ def test_main_grad_fp32_accumulation_beats_bf16():
     zeroed = reset_main_grads(main)
     assert float(jnp.max(jnp.abs(zeroed["w"]))) == 0.0
     assert zeroed["w"].dtype == jnp.float32
+
+
+def test_vocab_utility_and_split_helpers():
+    """API-parity tier for tensor_parallel.utils (reference:
+    apex/transformer/tensor_parallel/utils.py (U))."""
+    import pytest
+
+    from apex_tpu.transformer.tensor_parallel import (
+        VocabUtility,
+        divide,
+        ensure_divisibility,
+        split_tensor_along_last_dim,
+    )
+
+    assert divide(12, 4) == 3
+    with pytest.raises(ValueError):
+        ensure_divisibility(10, 3)
+
+    # ranges tile [0, vocab) exactly, in rank order
+    vocab, tp = 128, 4
+    ranges = [VocabUtility.vocab_range_from_global_vocab_size(vocab, r, tp)
+              for r in range(tp)]
+    assert ranges[0] == (0, 32) and ranges[-1] == (96, 128)
+    for (a0, a1), (b0, b1) in zip(ranges, ranges[1:]):
+        assert a1 == b0 and a1 - a0 == vocab // tp
+
+    x = jnp.arange(24.0).reshape(2, 12)
+    chunks = split_tensor_along_last_dim(x, 3)
+    assert len(chunks) == 3 and chunks[1].shape == (2, 4)
+    assert jnp.array_equal(jnp.concatenate(chunks, axis=-1), x)
+    with pytest.raises(ValueError):
+        split_tensor_along_last_dim(x, 5)
