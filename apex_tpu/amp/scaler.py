@@ -249,8 +249,12 @@ class LossScaler:
                            and _amp_state.ingraph_logging_enabled())
         if not ingraph_already:
             if self.dynamic:
-                reduced = (float(new_state.loss_scale)
-                           < float(prev_state.loss_scale))
+                # did the tracker back off this step? Mirror the in-graph
+                # rule (prev tolerance depleted by this overflow) rather
+                # than comparing scales: a back-off pinned at
+                # min_loss_scale leaves the value unchanged but is still
+                # the reference's "reducing" event.
+                reduced = int(prev_state.hysteresis) <= 1
                 if reduced:
                     _amp_state.maybe_print(
                         "Gradient overflow.  Skipping step, loss scaler "

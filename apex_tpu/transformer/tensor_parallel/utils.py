@@ -35,11 +35,14 @@ def divide(numerator: int, denominator: int) -> int:
     return numerator // denominator
 
 
-def split_tensor_along_last_dim(tensor, num_partitions: int) -> Sequence:
+def split_tensor_along_last_dim(
+        tensor, num_partitions: int,
+        contiguous_split_chunks: bool = False) -> Sequence:
     """Split a tensor into ``num_partitions`` equal chunks along its last
-    dimension (reference signature also takes ``contiguous_split_chunks``;
-    XLA arrays have no stride/contiguity notion, so every chunk here is
-    already "contiguous")."""
+    dimension. ``contiguous_split_chunks`` is accepted for drop-in parity
+    with reference call sites and ignored: XLA arrays have no
+    stride/contiguity notion, so every chunk here is already
+    "contiguous"."""
     last = tensor.shape[-1]
     divide(last, num_partitions)  # validates
     return jnp.split(tensor, num_partitions, axis=-1)

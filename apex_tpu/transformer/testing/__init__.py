@@ -1,0 +1,11 @@
+"""apex_tpu.transformer.testing — shared parallelism test helpers
+(reference: ``apex/transformer/testing/`` (U))."""
+
+from apex_tpu.transformer.testing.commons import (  # noqa: F401
+    IdentityLayer,
+    ToyParallelMLP,
+    initialize_distributed,
+    model_parallel_harness,
+    print_separator,
+    set_random_seed,
+)

@@ -1,0 +1,117 @@
+"""Shared helpers for parallelism tests and user experiments.
+
+Rebuild of the reference's ``apex/transformer/testing/commons.py`` (U)
+tier: deterministic seeding, tiny identity-ish modules, a toy MLP model,
+and the model-parallel harness the reference builds from
+``NcclDistributedTestBase`` (multi-process NCCL on one node). The TPU
+analog is stronger — ``model_parallel_harness`` runs the caller's
+function under ``shard_map`` on the current (possibly CPU-simulated)
+mesh, so "distributed" tests need no accelerator at all (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = [
+    "set_random_seed",
+    "IdentityLayer",
+    "ToyParallelMLP",
+    "initialize_distributed",
+    "model_parallel_harness",
+    "print_separator",
+]
+
+
+def set_random_seed(seed: int):
+    """Deterministic seeds for every RNG the tests touch (reference
+    ``commons.set_random_seed``: python/numpy/torch/model-parallel
+    trackers; here numpy + a returned JAX key — JAX keys are explicit,
+    so the key IS the seeding)."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+class IdentityLayer(nn.Module):
+    """A single learnable weight returned as-is (the reference's
+    ``IdentityLayer``): the minimal differentiable module for exercising
+    mappings/schedules without model noise."""
+
+    shape: tuple
+    scale: float = 1.0
+
+    @nn.compact
+    def __call__(self):
+        w = self.param("weight", nn.initializers.normal(self.scale),
+                       self.shape)
+        return w
+
+
+class ToyParallelMLP(nn.Module):
+    """Column→Row parallel 2-layer MLP — the smallest model that drives
+    the full TP mapping set (identity-fwd/psum-bwd, scatter/gather)."""
+
+    hidden: int
+    ffn: int
+
+    @nn.compact
+    def __call__(self, x):
+        from apex_tpu.transformer.tensor_parallel import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        h = ColumnParallelLinear(input_size=self.hidden,
+                                 output_size=self.ffn,
+                                 gather_output=False, name="fc1")(x)
+        h = jax.nn.gelu(h)
+        return RowParallelLinear(input_size=self.ffn,
+                                 output_size=self.hidden,
+                                 input_is_parallel=True, name="fc2")(h)
+
+
+def initialize_distributed(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1,
+                           **kw):
+    """Reference ``initialize_distributed`` analog: bring up the named
+    mesh (rather than a torch process group) and return it."""
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tensor_model_parallel_size,
+        pipeline_model_parallel_size_=pipeline_model_parallel_size, **kw)
+
+
+@contextlib.contextmanager
+def model_parallel_harness(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1, **kw):
+    """Context manager that initializes model parallelism, yields a
+    ``run(f, *args, in_specs=..., out_specs=...)`` callable executing
+    ``f`` jitted under ``shard_map`` on the full mesh, and tears the
+    mesh down afterwards — the role of the reference's
+    ``NcclDistributedTestBase`` setUp/tearDown pair."""
+    mesh = initialize_distributed(tensor_model_parallel_size,
+                                  pipeline_model_parallel_size, **kw)
+
+    def run(f, *args, in_specs=P(), out_specs=P(), check_vma=True):
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma))(*args)
+
+    try:
+        yield run
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def print_separator(message: str, width: int = 70):
+    """Reference test-output separator."""
+    filler = "-" * max(width - len(message) - 2, 0)
+    print(f"\n{'-' * width}\n {message} {filler}\n{'-' * width}", flush=True)
