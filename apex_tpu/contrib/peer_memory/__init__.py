@@ -1,0 +1,60 @@
+"""apex_tpu.contrib.peer_memory — direct neighbor exchange over ICI.
+
+Rebuild of the reference's ``apex/contrib/peer_memory/`` (U) +
+``apex/contrib/csrc/{peer_memory,nccl_p2p}/`` (U): raw GPU-P2P buffer
+pools and the 1-D halo exchanger the spatial-parallel bottleneck uses.
+
+TPU mapping: device-to-device moves are ``lax.ppermute`` hops over ICI;
+XLA owns the buffers, so the reference's explicitly-managed
+``PeerMemoryPool`` has no allocation job left — it survives as the
+topology descriptor the exchanger reads (group axis + halo geometry),
+keeping reference call sites shaped the same while the data path is the
+:class:`~apex_tpu.contrib.bottleneck.HaloExchanger1d` ppermute exchange.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from apex_tpu.contrib.bottleneck import HaloExchanger1d
+
+__all__ = ["PeerMemoryPool", "PeerHaloExchanger1d", "peer_send_recv"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerMemoryPool(object):
+    """Topology descriptor for peer exchanges (reference: a raw
+    cudaMalloc'd P2P buffer pool sized ``static_size``/``dynamic_size``;
+    here XLA manages device memory, so the sizes are accepted for call
+    -site parity and only the axis matters)."""
+
+    static_size: int = 0
+    dynamic_size: int = 0
+    peer_group_size: int = 0  # 0 = the full axis
+    axis_name: str = "spatial"
+
+
+class PeerHaloExchanger1d:
+    """Reference ``PeerHaloExchanger1d(ranks, rank_in_group, pool,
+    half_halo)``: exchange ``half_halo`` edge rows with ring neighbors.
+    Here the neighbor hop is ppermute over ``pool.axis_name``; run inside
+    ``shard_map`` with that axis in scope."""
+
+    def __init__(self, pool: PeerMemoryPool, half_halo: int = 1):
+        self.pool = pool
+        self.half_halo = half_halo
+        self._impl = HaloExchanger1d(pool.axis_name, half_halo)
+
+    def __call__(self, x):
+        return self._impl(x)
+
+
+def peer_send_recv(x, axis_name: str, shift: int = 1):
+    """One ring hop: every shard receives the ``x`` of its neighbor
+    ``shift`` positions back (the nccl_p2p send/recv pair; a single
+    ppermute over ICI)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)

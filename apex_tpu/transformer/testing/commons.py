@@ -99,11 +99,18 @@ def model_parallel_harness(tensor_model_parallel_size: int = 1,
     ``NcclDistributedTestBase`` setUp/tearDown pair."""
     mesh = initialize_distributed(tensor_model_parallel_size,
                                   pipeline_model_parallel_size, **kw)
+    cache = {}
 
     def run(f, *args, in_specs=P(), out_specs=P(), check_vma=True):
-        return jax.jit(jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_vma))(*args)
+        # cache the jitted wrapper per (f, specs): a fresh shard_map+jit
+        # object every call would retrace/recompile on each invocation,
+        # which matters when run() drives a training loop
+        key = (f, str(in_specs), str(out_specs), check_vma)
+        if key not in cache:
+            cache[key] = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma))
+        return cache[key](*args)
 
     try:
         yield run

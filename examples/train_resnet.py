@@ -102,8 +102,9 @@ def main():
                                   delay_allreduce=args.delay_allreduce)
     opt_state = opt.init(params)
     scaler_state = handle.init_state()
-    compute_dtype = (handle.properties.cast_model_type
-                     or handle.properties.compute_dtype or jnp.float32)
+    # compute_dtype already resolves to cast_model_type when set, else
+    # the O1 autocast dtype (bf16), else fp32 for O0
+    compute_dtype = handle.properties.compute_dtype
 
     def train_step(params, bstats, opt_state, scaler_state, x, y):
         def loss_fn(p):

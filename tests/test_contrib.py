@@ -476,3 +476,45 @@ def test_cudnn_gbn_alias():
     variables = bn.init(jax.random.PRNGKey(0), x, train=False)
     out = bn.apply(variables, x, train=False)
     assert out.shape == x.shape
+
+
+def test_peer_memory_halo_and_send_recv():
+    """contrib.peer_memory surface (reference: apex/contrib/peer_memory/
+    (U)): the pool-shaped exchanger equals HaloExchanger1d, and
+    peer_send_recv performs one ring hop."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.peer_memory import (
+        PeerHaloExchanger1d,
+        PeerMemoryPool,
+        peer_send_recv,
+    )
+
+    mesh = jax.make_mesh((8,), ("spatial",))
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)  # 8 shards
+
+    def hop(x_local):
+        return peer_send_recv(x_local, "spatial", shift=1)
+
+    out = jax.jit(jax.shard_map(hop, mesh=mesh, in_specs=P("spatial"),
+                                out_specs=P("spatial")))(x)
+    # shard i receives shard i-1's rows (ring)
+    np.testing.assert_array_equal(np.asarray(out), np.roll(x, 1, axis=0))
+
+    pool = PeerMemoryPool(axis_name="spatial")
+    ex = PeerHaloExchanger1d(pool, half_halo=1)
+    img = jnp.arange(8 * 2 * 3 * 1, dtype=jnp.float32).reshape(1, 16, 3, 1)
+
+    def halo(img_local):
+        return ex(img_local)
+
+    padded = jax.jit(jax.shard_map(
+        halo, mesh=mesh, in_specs=P(None, "spatial"),
+        out_specs=P(None, "spatial")))(img)
+    # each 2-row shard gains one halo row per side -> 4 rows per shard
+    assert padded.shape == (1, 32, 3, 1)
+    full = np.asarray(img)[0, :, :, 0]
+    got = np.asarray(padded)[0].reshape(8, 4, 3)[3]  # shard 3
+    np.testing.assert_array_equal(got[0], full[2 * 3 - 1])  # prev edge
+    np.testing.assert_array_equal(got[1:3], full[6:8])      # own rows
+    np.testing.assert_array_equal(got[3], full[8])          # next edge
