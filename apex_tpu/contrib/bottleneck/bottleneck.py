@@ -82,26 +82,43 @@ class HaloExchanger1d:
     Operates on the H-sharded (N, H_local, W, C) tensor inside
     ``shard_map``: returns the tensor padded to
     (N, halo + H_local + halo, W, C) with the neighbors' edge rows (zero
-    at the true image borders — the first/last shard)."""
+    at the true image borders — the first/last shard of each group).
 
-    def __init__(self, axis_name: str, halo: int = 1):
+    ``group_size`` (0 = the whole axis) partitions the axis into
+    independent spatial groups of consecutive ranks, each holding one
+    image: halos never cross group borders (the reference's
+    ``peer_group_size``)."""
+
+    def __init__(self, axis_name: str, halo: int = 1, group_size: int = 0):
         self.axis_name = axis_name
         self.halo = halo
+        self.group_size = group_size
 
     def __call__(self, x):
         axis = self.axis_name
         n = jax.lax.psum(1, axis)
         idx = jax.lax.axis_index(axis)
+        g = self.group_size or n
+        if g > n or n % g:
+            # a partial trailing group would let the last rank's halo wrap
+            # around the ring to rank 0 — cross-image leakage
+            raise ValueError(
+                f"group_size ({g}) must divide the '{axis}' axis size "
+                f"({n})")
         fwd = [(i, (i + 1) % n) for i in range(n)]
         bwd = [(i, (i - 1) % n) for i in range(n)]
-        # my bottom rows -> next shard's top halo; my top rows -> prev's
+        # my bottom rows -> next shard's top halo; my top rows -> prev's.
+        # The permute stays a full ring: rows that would cross a group
+        # border are zeroed below, so they never contribute.
         bottom = x[:, -self.halo:]
         top = x[:, :self.halo]
         from_prev = jax.lax.ppermute(bottom, axis, fwd)
         from_next = jax.lax.ppermute(top, axis, bwd)
-        # zero halos at the image borders (no wraparound receptive field)
-        from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
-        from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
+        # zero halos at each group's image borders (no wraparound and no
+        # cross-group receptive field)
+        from_prev = jnp.where(idx % g == 0, jnp.zeros_like(from_prev),
+                              from_prev)
+        from_next = jnp.where(idx % g == g - 1, jnp.zeros_like(from_next),
                               from_next)
         return jnp.concatenate([from_prev, x, from_next], axis=1)
 

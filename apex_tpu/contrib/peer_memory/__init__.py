@@ -40,12 +40,28 @@ class PeerHaloExchanger1d:
     """Reference ``PeerHaloExchanger1d(ranks, rank_in_group, pool,
     half_halo)``: exchange ``half_halo`` edge rows with ring neighbors.
     Here the neighbor hop is ppermute over ``pool.axis_name``; run inside
-    ``shard_map`` with that axis in scope."""
+    ``shard_map`` with that axis in scope.
 
-    def __init__(self, pool: PeerMemoryPool, half_halo: int = 1):
+    ``ranks``/``rank_in_group`` are accepted for reference call-site
+    parity and ignored — under SPMD every rank runs the same program and
+    ``lax.axis_index`` supplies the rank; the group partitioning comes
+    from ``pool.peer_group_size``. The short form
+    ``PeerHaloExchanger1d(pool, half_halo)`` also works."""
+
+    def __init__(self, ranks=None, rank_in_group=None, pool=None,
+                 half_halo: int = 1):
+        if isinstance(ranks, PeerMemoryPool) and pool is None:
+            # short form: first positional is the pool
+            pool, ranks = ranks, None
+            if isinstance(rank_in_group, int):
+                half_halo, rank_in_group = rank_in_group, None
+        if pool is None:
+            raise TypeError("PeerHaloExchanger1d needs a PeerMemoryPool "
+                            "(reference arg 3, or first positional)")
         self.pool = pool
         self.half_halo = half_halo
-        self._impl = HaloExchanger1d(pool.axis_name, half_halo)
+        self._impl = HaloExchanger1d(pool.axis_name, half_halo,
+                                     group_size=pool.peer_group_size)
 
     def __call__(self, x):
         return self._impl(x)

@@ -11,6 +11,7 @@ mesh, so "distributed" tests need no accelerator at all (SURVEY.md §4).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 from typing import Optional
 
@@ -99,14 +100,22 @@ def model_parallel_harness(tensor_model_parallel_size: int = 1,
     ``NcclDistributedTestBase`` setUp/tearDown pair."""
     mesh = initialize_distributed(tensor_model_parallel_size,
                                   pipeline_model_parallel_size, **kw)
-    cache = {}
+    cache = collections.OrderedDict()
+    _CACHE_MAX = 32
 
     def run(f, *args, in_specs=P(), out_specs=P(), check_vma=True):
-        # cache the jitted wrapper per (f, specs): a fresh shard_map+jit
-        # object every call would retrace/recompile on each invocation,
-        # which matters when run() drives a training loop
+        # Cache the jitted wrapper per (f identity, specs) so repeated
+        # calls with a STABLE function skip retrace/recompile. Pass a
+        # module-level or otherwise long-lived fn for this to help: a
+        # fresh lambda each call is a new identity and always misses.
+        # LRU-bounded so closure-per-call misses cannot pin unbounded
+        # executables/captured arrays until teardown.
         key = (f, str(in_specs), str(out_specs), check_vma)
-        if key not in cache:
+        if key in cache:
+            cache.move_to_end(key)
+        else:
+            if len(cache) >= _CACHE_MAX:
+                cache.popitem(last=False)
             cache[key] = jax.jit(jax.shard_map(
                 f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=check_vma))

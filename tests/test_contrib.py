@@ -518,3 +518,48 @@ def test_peer_memory_halo_and_send_recv():
     np.testing.assert_array_equal(got[0], full[2 * 3 - 1])  # prev edge
     np.testing.assert_array_equal(got[1:3], full[6:8])      # own rows
     np.testing.assert_array_equal(got[3], full[8])          # next edge
+
+
+def test_peer_memory_group_size_isolates_groups():
+    """peer_group_size=4 on an 8-rank axis: halos never cross the group
+    border (rank 3's next-halo and rank 4's prev-halo are zero), and the
+    reference 4-arg constructor form ports."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.peer_memory import (
+        PeerHaloExchanger1d,
+        PeerMemoryPool,
+    )
+
+    mesh = jax.make_mesh((8,), ("spatial",))
+    pool = PeerMemoryPool(axis_name="spatial", peer_group_size=4)
+    # reference ctor shape: (ranks, rank_in_group, pool, half_halo)
+    ex = PeerHaloExchanger1d(list(range(8)), 0, pool, 1)
+    img = jnp.arange(16.0).reshape(1, 16, 1, 1) + 1.0  # rows 1..16
+
+    padded = jax.jit(jax.shard_map(
+        lambda t: ex(t), mesh=mesh, in_specs=P(None, "spatial"),
+        out_specs=P(None, "spatial")))(img)
+    shards = np.asarray(padded)[0].reshape(8, 4)  # 2 own rows + 2 halos
+    # group border between rank 3 and 4: no leakage either way
+    assert shards[3, 3] == 0.0   # rank 3 next-halo zeroed (group edge)
+    assert shards[4, 0] == 0.0   # rank 4 prev-halo zeroed (group edge)
+    # interior neighbor still exchanged
+    assert shards[1, 0] == 2.0   # rank 1 prev-halo = rank 0's last row
+    assert shards[2, 3] == 7.0   # rank 2 next-halo = rank 3's first row
+
+
+def test_peer_memory_rejects_non_dividing_group_size():
+    """group_size that does not divide the axis would wrap the last
+    rank's halo around the ring (cross-image leakage) — must raise."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.bottleneck import HaloExchanger1d
+
+    mesh = jax.make_mesh((8,), ("spatial",))
+    ex = HaloExchanger1d("spatial", 1, group_size=3)
+    img = jnp.zeros((1, 16, 1, 1))
+    with pytest.raises(ValueError, match="must divide"):
+        jax.jit(jax.shard_map(
+            lambda t: ex(t), mesh=mesh, in_specs=P(None, "spatial"),
+            out_specs=P(None, "spatial")))(img)
