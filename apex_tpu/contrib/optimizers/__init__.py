@@ -1,13 +1,25 @@
-"""Contrib optimizers (reference: ``apex/contrib/optimizers/``)."""
+"""Contrib optimizers (reference: ``apex/contrib/optimizers/``).
+
+Besides the distributed (ZeRO-style) optimizers, the reference keeps
+deprecated copies of ``FP16_Optimizer``/``FusedAdam``/``FusedSGD`` under
+contrib; those names resolve here to the maintained implementations
+(``apex_tpu.fp16_utils`` / ``apex_tpu.optimizers``) rather than stale
+forks — same import paths, one source of truth.
+"""
 
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     DistributedFusedAdam,
     DistributedFusedLAMB,
     ShardedOptState,
 )
+from apex_tpu.fp16_utils import FP16_Optimizer  # noqa: F401 (legacy path)
+from apex_tpu.optimizers import FusedAdam, FusedSGD  # noqa: F401 (legacy)
 
 __all__ = [
     "DistributedFusedAdam",
     "DistributedFusedLAMB",
     "ShardedOptState",
+    "FP16_Optimizer",
+    "FusedAdam",
+    "FusedSGD",
 ]

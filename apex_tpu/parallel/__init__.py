@@ -5,6 +5,11 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     flat_dist_call,
 )
+from apex_tpu.parallel.bootstrap import (  # noqa: F401
+    get_rank,
+    get_world_size,
+    init_process_group,
+)
 from apex_tpu.parallel.larc import LARC  # noqa: F401
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,

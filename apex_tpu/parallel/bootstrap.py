@@ -1,0 +1,98 @@
+"""Multi-host process bootstrap.
+
+The reference never initializes the process group itself — user scripts
+call ``torch.distributed.init_process_group("nccl", ...)`` before
+touching ``apex.parallel`` (SURVEY.md §2.4). The JAX analog is
+``jax.distributed.initialize``: one process per host, called BEFORE any
+backend use, after which ``jax.devices()`` spans every chip in the
+slice/pod and any ``jax.sharding.Mesh`` built from them (including
+``parallel_state.initialize_model_parallel``) lays its collectives over
+ICI within a slice and DCN across slices automatically.
+
+This module wraps that call with the reference's env-driven conventions
+(``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK`` → the
+corresponding coordinator settings) so a training script ports with one
+renamed call. Call it first thing in ``main()`` — before any jax
+operation that would initialize a backend.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_process_group(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None,
+                       local_device_ids=None,
+                       auto: bool = False) -> None:
+    """``torch.distributed.init_process_group("nccl")`` analog.
+
+    Resolution order:
+
+    1. Explicit args, or the reference-style env vars ``MASTER_ADDR``
+       (+``MASTER_PORT``, default 8476), ``WORLD_SIZE``, ``RANK`` →
+       ``jax.distributed.initialize(coordinator, num, id)``.
+    2. ``auto=True`` → bare ``jax.distributed.initialize()`` (cluster
+       auto-discovery: GCE TPU-pod metadata, SLURM, etc.).
+    3. Neither → single-process no-op, matching how apex scripts run
+       unmodified on one GPU. NOTE a multi-host TPU pod is NOT detected
+       implicitly — pass ``auto=True`` (or set the env vars) on pods,
+       or each host silently trains alone.
+
+    Must run before the first JAX backend use (a jax constraint); a
+    partially-specified env (``MASTER_ADDR`` without ``WORLD_SIZE`` and
+    ``RANK``) raises rather than guessing.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and "MASTER_ADDR" in os.environ:
+        port = os.environ.get("MASTER_PORT", "8476")
+        coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    explicit = [coordinator_address, num_processes, process_id]
+    if any(v is not None for v in explicit):
+        if any(v is None for v in explicit):
+            raise ValueError(
+                "init_process_group: coordinator_address, num_processes, "
+                "and process_id must all be provided (args or "
+                "MASTER_ADDR/WORLD_SIZE/RANK env) — got "
+                f"{coordinator_address=}, {num_processes=}, {process_id=}")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    elif auto:
+        # cluster auto-discovery happens inside initialize() itself
+        jax.distributed.initialize(local_device_ids=local_device_ids)
+    # else: single-process run — nothing to bootstrap
+    _initialized = True
+
+
+def get_world_size() -> int:
+    """CHIP world size, ``jax.device_count()`` — the value ported
+    gradient-averaging / LR-scaling math wants. (torch ranks are
+    per-GPU; JAX processes are per-host, so ``jax.process_count()`` is
+    NOT the torch world size. For the host count use
+    ``jax.process_count()`` directly.)"""
+    return jax.device_count()
+
+
+def get_rank() -> int:
+    """Host (process) index. There is no global per-chip rank outside a
+    mesh context — inside ``shard_map`` use ``jax.lax.axis_index`` on
+    the relevant mesh axis, which is what ported per-rank logic should
+    key on."""
+    return jax.process_index()

@@ -224,3 +224,35 @@ def test_mixed_vma_tree_not_double_reduced():
         np.testing.assert_allclose(np.asarray(out["u"]), 0.125, rtol=1e-6)
         # varying leaf: psum/world = mean = 3.5
         np.testing.assert_allclose(np.asarray(out["v"]), 3.5, rtol=1e-6)
+
+
+def test_bootstrap_single_process_noop_and_env_parsing(monkeypatch):
+    """init_process_group (the torch.distributed.init_process_group
+    analog): single-process call no-ops, partial env raises, and the
+    world helpers report CHIP world (torch semantics), not host count."""
+    import pytest
+
+    from apex_tpu.parallel import (
+        get_rank,
+        get_world_size,
+        init_process_group,
+    )
+    from apex_tpu.parallel import bootstrap
+
+    for var in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(bootstrap, "_initialized", False)
+    init_process_group()  # no coordinator, no auto: must no-op
+    assert bootstrap._initialized
+    # torch world size is per-rank(-GPU): the chip count, not the host
+    # count — on the 8-device sim that is 8
+    assert get_world_size() == jax.device_count() == 8
+    assert get_rank() == 0
+    init_process_group()  # idempotent
+
+    # partial env (stale MASTER_ADDR, no WORLD_SIZE/RANK) must raise,
+    # not crash inside jax.distributed.initialize
+    monkeypatch.setattr(bootstrap, "_initialized", False)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    with pytest.raises(ValueError, match="must all be provided"):
+        init_process_group()
