@@ -9,11 +9,15 @@ slice/pod and any ``jax.sharding.Mesh`` built from them (including
 ``parallel_state.initialize_model_parallel``) lays its collectives over
 ICI within a slice and DCN across slices automatically.
 
-This module wraps that call with the reference's env-driven conventions
-(``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK`` → the
-corresponding coordinator settings) so a training script ports with one
-renamed call. Call it first thing in ``main()`` — before any jax
-operation that would initialize a backend.
+This module wraps that call with env-driven conventions
+(``MASTER_ADDR``/``MASTER_PORT`` for the coordinator;
+``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` for the per-HOST process
+layout) so a training script ports with one renamed call. Call it first
+thing in ``main()`` — before any jax operation that would initialize a
+backend. torchrun-style ``WORLD_SIZE``/``RANK`` are deliberately NOT
+consumed: their torch semantics are per-GPU while a JAX process is
+per-host, so silently mapping them would stand up a wrong-shaped (or
+hung) cluster on any multi-chip host.
 """
 
 from __future__ import annotations
@@ -23,7 +27,9 @@ from typing import Optional
 
 import jax
 
-_initialized = False
+# "" = not bootstrapped; "noop" = single-process fast path taken;
+# "initialized" = jax.distributed.initialize ran
+_mode = ""
 
 
 def init_process_group(coordinator_address: Optional[str] = None,
@@ -35,9 +41,13 @@ def init_process_group(coordinator_address: Optional[str] = None,
 
     Resolution order:
 
-    1. Explicit args, or the reference-style env vars ``MASTER_ADDR``
-       (+``MASTER_PORT``, default 8476), ``WORLD_SIZE``, ``RANK`` →
-       ``jax.distributed.initialize(coordinator, num, id)``.
+    1. Explicit args, or env: ``MASTER_ADDR`` (+``MASTER_PORT``, default
+       8476) for the coordinator and ``JAX_NUM_PROCESSES`` /
+       ``JAX_PROCESS_ID`` for the per-HOST process count/index →
+       ``jax.distributed.initialize(coordinator, num, id)``. All three
+       must resolve or this raises (no guessing). torch ``WORLD_SIZE``/
+       ``RANK`` are per-GPU and intentionally ignored — export the JAX
+       per-host values instead.
     2. ``auto=True`` → bare ``jax.distributed.initialize()`` (cluster
        auto-discovery: GCE TPU-pod metadata, SLURM, etc.).
     3. Neither → single-process no-op, matching how apex scripts run
@@ -45,28 +55,33 @@ def init_process_group(coordinator_address: Optional[str] = None,
        implicitly — pass ``auto=True`` (or set the env vars) on pods,
        or each host silently trains alone.
 
-    Must run before the first JAX backend use (a jax constraint); a
-    partially-specified env (``MASTER_ADDR`` without ``WORLD_SIZE`` and
-    ``RANK``) raises rather than guessing.
+    A later call that carries args/``auto`` after a no-op first call is
+    honored (it will raise jax's must-run-before-backend error if JAX
+    was used in between — loud, not silent); after a real initialize,
+    further calls are idempotent no-ops.
     """
-    global _initialized
-    if _initialized:
+    global _mode
+    wants_cluster = auto or any(
+        v is not None for v in (coordinator_address, num_processes,
+                                process_id)) or "MASTER_ADDR" in os.environ
+    if _mode == "initialized" or (_mode == "noop" and not wants_cluster):
         return
     if coordinator_address is None and "MASTER_ADDR" in os.environ:
         port = os.environ.get("MASTER_PORT", "8476")
         coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
-    if num_processes is None and "WORLD_SIZE" in os.environ:
-        num_processes = int(os.environ["WORLD_SIZE"])
-    if process_id is None and "RANK" in os.environ:
-        process_id = int(os.environ["RANK"])
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
 
     explicit = [coordinator_address, num_processes, process_id]
     if any(v is not None for v in explicit):
         if any(v is None for v in explicit):
             raise ValueError(
                 "init_process_group: coordinator_address, num_processes, "
-                "and process_id must all be provided (args or "
-                "MASTER_ADDR/WORLD_SIZE/RANK env) — got "
+                "and process_id must all be provided (args, or MASTER_ADDR"
+                " + JAX_NUM_PROCESSES + JAX_PROCESS_ID env; torch "
+                "WORLD_SIZE/RANK are per-GPU and are not consumed) — got "
                 f"{coordinator_address=}, {num_processes=}, {process_id=}")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -74,11 +89,14 @@ def init_process_group(coordinator_address: Optional[str] = None,
             process_id=process_id,
             local_device_ids=local_device_ids,
         )
+        _mode = "initialized"
     elif auto:
         # cluster auto-discovery happens inside initialize() itself
         jax.distributed.initialize(local_device_ids=local_device_ids)
-    # else: single-process run — nothing to bootstrap
-    _initialized = True
+        _mode = "initialized"
+    else:
+        # single-process run — nothing to bootstrap
+        _mode = "noop"
 
 
 def get_world_size() -> int:

@@ -2,6 +2,7 @@
 device mesh (SURVEY.md §2.3: TP + PP + Megatron-SP, rebuild of
 ``apex.transformer``)."""
 
+from apex_tpu.transformer import enums  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401

@@ -239,20 +239,27 @@ def test_bootstrap_single_process_noop_and_env_parsing(monkeypatch):
     )
     from apex_tpu.parallel import bootstrap
 
-    for var in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
+    for var in ("MASTER_ADDR", "MASTER_PORT", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
         monkeypatch.delenv(var, raising=False)
-    monkeypatch.setattr(bootstrap, "_initialized", False)
+    monkeypatch.setattr(bootstrap, "_mode", "")
     init_process_group()  # no coordinator, no auto: must no-op
-    assert bootstrap._initialized
+    assert bootstrap._mode == "noop"
     # torch world size is per-rank(-GPU): the chip count, not the host
     # count — on the 8-device sim that is 8
     assert get_world_size() == jax.device_count() == 8
     assert get_rank() == 0
     init_process_group()  # idempotent
 
-    # partial env (stale MASTER_ADDR, no WORLD_SIZE/RANK) must raise,
-    # not crash inside jax.distributed.initialize
-    monkeypatch.setattr(bootstrap, "_initialized", False)
+    # partial env (stale MASTER_ADDR, no JAX_NUM_PROCESSES/JAX_PROCESS_ID)
+    # must raise clearly, not crash inside jax.distributed.initialize —
+    # and a no-op latch must NOT swallow a later call that wants a
+    # cluster (the silent-solo-training failure mode)
     monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
     with pytest.raises(ValueError, match="must all be provided"):
+        init_process_group()
+    # torchrun-style WORLD_SIZE/RANK are per-GPU: ignored, still raises
+    monkeypatch.setenv("WORLD_SIZE", "32")
+    monkeypatch.setenv("RANK", "0")
+    with pytest.raises(ValueError, match="not consumed"):
         init_process_group()

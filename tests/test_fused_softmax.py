@@ -138,3 +138,23 @@ def test_module_handles_2d_and_5d_inputs():
     np.testing.assert_allclose(np.asarray(y2.sum(-1)), 1.0, rtol=1e-5)
     y5 = sm(_x((2, 2, 3, 4, 32)))
     np.testing.assert_allclose(np.asarray(y5.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_transformer_enums_surface():
+    """apex.transformer.enums parity: AttnMaskType re-exported next to
+    the softmax it configures; structural selectors present."""
+    from apex_tpu.transformer.enums import (
+        AttnMaskType,
+        AttnType,
+        LayerType,
+        ModelType,
+    )
+    from apex_tpu.transformer.functional import (
+        AttnMaskType as FunctionalAttnMaskType,
+    )
+
+    assert AttnMaskType is FunctionalAttnMaskType
+    assert {m.name for m in ModelType} == {"encoder_or_decoder",
+                                           "encoder_and_decoder"}
+    assert {m.name for m in LayerType} == {"encoder", "decoder"}
+    assert {m.name for m in AttnType} == {"self_attn", "cross_attn"}
