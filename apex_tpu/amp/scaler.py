@@ -132,14 +132,16 @@ class LossScaler:
                 grown),
             unskipped=jnp.where(found_inf, 0, jnp.where(grow, 0, unskipped)).astype(jnp.int32),
             steps_skipped=state.steps_skipped + found_inf.astype(jnp.int32),
-            # the tolerance only replenishes on a growth event (reference
-            # tracker semantics): once depleted, every further consecutive
-            # overflow backs off, so recovery from a far-too-high scale is
-            # not slowed by hysteresis. Clamp at 0 to keep the <=0 test
-            # stable instead of drifting negative.
+            # EVERY clean step replenishes the tolerance to its full value
+            # (the cited kernel zeroes then refills hysteresis_tracker on a
+            # non-overflow step), so only *consecutive* overflows deplete
+            # it: with hysteresis>1, spiky losses whose overflows are
+            # separated by clean steps never back the scale off. Note this
+            # differs from Megatron's DynamicGradScaler, which replenishes
+            # only on a growth event. Clamp the overflow branch at 0 to
+            # keep the <=0 test stable instead of drifting negative.
             hysteresis=jnp.where(
-                found_inf, jnp.maximum(hys, 0),
-                jnp.where(grow, reset_hys, state.hysteresis)
+                found_inf, jnp.maximum(hys, 0), reset_hys
             ).astype(jnp.int32),
         )
         if _amp_state.ingraph_logging_enabled() and _amp_state.get_verbosity() >= 1:

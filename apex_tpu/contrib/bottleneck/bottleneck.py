@@ -137,6 +137,11 @@ class SpatialBottleneck(nn.Module):
     halo: int = 1
     bn_group: int = 1                 # cross-replica BN (the reference
     axis_name: Optional[str] = None   # runs group BN on spatial groups)
+    # partition the spatial axis into independent groups of this many
+    # consecutive ranks, one image per group (the reference wires
+    # peer_group_size from PeerMemoryPool into the bottleneck's halo
+    # exchange); 0 = the whole axis is one group
+    peer_group_size: int = 0
 
     _bn = Bottleneck._bn
 
@@ -153,7 +158,8 @@ class SpatialBottleneck(nn.Module):
             y, train=train)
         # 3x3 with cross-shard receptive field: pad with neighbor halos,
         # convolve VALID-in-H, trimming the halo contribution exactly
-        exchanger = HaloExchanger1d(self.spatial_axis, self.halo)
+        exchanger = HaloExchanger1d(self.spatial_axis, self.halo,
+                                    group_size=self.peer_group_size)
         y = exchanger(y)
         y = nn.Conv(self.bottleneck_channels, (3, 3), strides=(1, 1),
                     padding=((0, 0), (1, 1)), use_bias=False,

@@ -140,16 +140,19 @@ def multi_tensor_l2norm(chunk_size, noop_flag, tensor_lists, per_tensor=False):
 
 def multi_tensor_l2norm_scale(chunk_size, noop_flag, tensor_lists, scale,
                               per_tensor=False):
-    """Fused scale + L2 norm: ``out = in * scale`` while reducing the L2
-    norms of the *scaled* values in the same pass
-    (``amp_C.multi_tensor_l2norm_scale``, reference
+    """Fused scale + L2 norm. RETURN SHAPE DIVERGES FROM THE REFERENCE
+    BINDING: this returns the 4-tuple ``(out_list, global_norm,
+    per_tensor_norms_or_None, noop_flag_out)``, while
+    ``amp_C.multi_tensor_l2norm_scale`` returns ``(norm, per_tensor)``
+    and writes outputs in place — functional JAX has no in-place write,
+    so porters unpacking two values must rebind ``(_, norm, per, _)``.
+
+    Semantics: ``out = in * scale`` while reducing the L2 norms of the
+    *scaled* values in the same pass (reference
     ``csrc/multi_tensor_l2norm_scale_kernel.cu`` (U) — used by the
     distributed LAMB path to unscale gradients and get their norms with
     one read of HBM; here the scale, square, and sum fuse under XLA the
     same way).
-
-    Returns ``(out_list, global_norm, per_tensor_norms_or_None,
-    noop_flag_out)``.
     """
     scaled, outs, flag_out = _scaled_with_flag(noop_flag, tensor_lists, scale)
     sq = jnp.stack([jnp.sum(jnp.square(s)) for s in scaled]) if scaled else (

@@ -6,6 +6,9 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     flat_dist_call,
 )
 from apex_tpu.parallel.bootstrap import (  # noqa: F401
+    get_chip_count,
+    get_host_count,
+    get_host_rank,
     get_rank,
     get_world_size,
     init_process_group,

@@ -103,14 +103,42 @@ def get_world_size() -> int:
     """CHIP world size, ``jax.device_count()`` — the value ported
     gradient-averaging / LR-scaling math wants. (torch ranks are
     per-GPU; JAX processes are per-host, so ``jax.process_count()`` is
-    NOT the torch world size. For the host count use
-    ``jax.process_count()`` directly.)"""
+    NOT the torch world size.)
+
+    .. warning:: This does NOT pair with :func:`get_rank`, which returns
+       the HOST index — ``get_rank()`` is not in
+       ``range(get_world_size())`` on multi-chip hosts. Self-consistent
+       pairs are (:func:`get_host_rank`, :func:`get_host_count`) for
+       per-process logic and ``jax.lax.axis_index`` over a mesh axis for
+       per-chip logic; ported ``data[rank::world_size]`` idioms must use
+       one of those, never this mixed pair."""
     return jax.device_count()
 
 
+def get_chip_count() -> int:
+    """Alias for :func:`get_world_size` with an unambiguous name."""
+    return jax.device_count()
+
+
+def get_host_count() -> int:
+    """Number of processes (hosts), ``jax.process_count()`` — the
+    denominator that pairs with :func:`get_host_rank`."""
+    return jax.process_count()
+
+
+def get_host_rank() -> int:
+    """This process's index in ``range(get_host_count())`` — the
+    self-consistent (rank, world) pair for per-process sharding such as
+    input pipelines."""
+    return jax.process_index()
+
+
 def get_rank() -> int:
-    """Host (process) index. There is no global per-chip rank outside a
-    mesh context — inside ``shard_map`` use ``jax.lax.axis_index`` on
-    the relevant mesh axis, which is what ported per-rank logic should
-    key on."""
+    """Host (process) index — NOT a per-chip rank, and NOT an index into
+    :func:`get_world_size` (which counts chips): on a 4-chip host this
+    returns 0 while ``get_world_size()`` returns 4. Use the
+    (:func:`get_host_rank`, :func:`get_host_count`) pair for per-process
+    logic. There is no global per-chip rank outside a mesh context —
+    inside ``shard_map`` use ``jax.lax.axis_index`` on the relevant mesh
+    axis, which is what ported per-rank logic should key on."""
     return jax.process_index()

@@ -206,15 +206,23 @@ def test_hysteresis_delays_backoff():
     assert float(st.loss_scale) == 2.0 ** 14
 
 
-def test_hysteresis_replenishes_on_growth():
-    scaler = LossScaler(hysteresis=2, scale_seq_len=2)
+def test_hysteresis_replenishes_on_every_clean_step():
+    """The reference kernel (amp_C.update_scale_hysteresis) refills the
+    tracker to its full value on EVERY non-overflow step, so only
+    *consecutive* overflows deplete it — spiky losses whose overflows
+    are separated by clean steps must never back the scale off."""
+    scaler = LossScaler(hysteresis=2, scale_seq_len=2000)
     st = scaler.init()
     st = scaler.update(st, jnp.asarray(True))   # tolerance 2 -> 1
     assert int(st.hysteresis) == 1
-    st = scaler.update(st, jnp.asarray(False))
-    st = scaler.update(st, jnp.asarray(False))  # growth event
-    assert float(st.loss_scale) == 2.0 ** 17
-    assert int(st.hysteresis) == 2              # replenished
+    st = scaler.update(st, jnp.asarray(False))  # clean: refilled to 2
+    assert int(st.hysteresis) == 2
+    # alternating overflow/clean forever: the scale holds
+    for _ in range(4):
+        st = scaler.update(st, jnp.asarray(True))
+        st = scaler.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 2.0 ** 16
+    assert int(st.steps_skipped) == 5
 
 
 def test_default_hysteresis_matches_reference_backoff():

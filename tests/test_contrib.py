@@ -356,6 +356,47 @@ def test_spatial_bottleneck_runs_sharded():
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_spatial_bottleneck_peer_group_size_threads_to_exchanger():
+    """peer_group_size reaches the bottleneck's own halo exchange (the
+    reference wires PeerMemoryPool's peer_group_size through
+    SpatialBottleneck): group borders behave as image borders, so the
+    output of two 4-rank groups matches two independent 4-rank runs."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 16, 4, 8).astype("float32"))
+    mesh8 = jax.make_mesh((8,), ("spatial",))
+    mesh4 = jax.make_mesh((4,), ("spatial",),
+                          devices=jax.devices()[:4])
+    blk_g = SpatialBottleneck(8, 4, 8, spatial_axis="spatial",
+                              peer_group_size=4)
+    blk_1 = SpatialBottleneck(8, 4, 8, spatial_axis="spatial")
+    mesh1 = jax.make_mesh((1,), ("spatial",), devices=jax.devices()[:1])
+    variables = jax.jit(jax.shard_map(
+        lambda xl: blk_1.init(jax.random.PRNGKey(0), xl, False),
+        mesh=mesh1, in_specs=P(None, "spatial"), out_specs=P()))(x[:, :2])
+    variables = jax.tree.map(np.asarray, variables)
+
+    def apply(blk):
+        def f(variables, x_local):
+            out, _ = blk.apply(variables, x_local, train=False,
+                               mutable=["batch_stats"])
+            return out
+        return f
+
+    grouped = jax.jit(jax.shard_map(
+        apply(blk_g), mesh=mesh8, in_specs=(P(), P(None, "spatial")),
+        out_specs=P(None, "spatial")))(variables, x)
+    halves = [
+        jax.jit(jax.shard_map(
+            apply(blk_1), mesh=mesh4, in_specs=(P(), P(None, "spatial")),
+            out_specs=P(None, "spatial")))(variables, half)
+        for half in (x[:, :8], x[:, 8:])
+    ]
+    np.testing.assert_allclose(np.asarray(grouped),
+                               np.concatenate([np.asarray(h) for h in halves],
+                                              axis=1),
+                               rtol=1e-5, atol=1e-5)
+
+
 # ---------------------------------------------------------- transducer
 
 def _np_rnnt_loss(log_probs, labels, T, U):
