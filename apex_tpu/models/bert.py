@@ -55,8 +55,8 @@ class BertConfig:
     fused_kernels: bool = True       # Pallas LN/softmax vs stock ops
     # Pallas flash attention (reference: contrib fmha). Used when the
     # sequence is long enough to win (>= flash_min_seq; measured v5e
-    # crossover) and attention dropout is inactive (the composed-softmax
-    # path covers training-time attention dropout).
+    # crossover); attention dropout is fused in-kernel (hardware PRNG),
+    # so the training config keeps the flash path.
     flash_attention: bool = True
     flash_min_seq: int = 256
     # multi-chip: use tensor_parallel layers (requires bound "tensor" axis)
@@ -116,6 +116,21 @@ def _attn_softmax(cfg, scores, mask):
     return jax.nn.softmax(xf, axis=-1).astype(scores.dtype)
 
 
+def _dropout_seed(module, tp_fold: bool):
+    """int32 seed for the fused in-kernel dropout, derived from the flax
+    "dropout" stream; ``tp_fold`` mixes in the TP rank so head-sharded
+    regions decorrelate across ranks (CudaRNGStatesTracker semantics)
+    while replicated regions share one mask."""
+    key = module.make_rng("dropout")
+    if tp_fold:
+        from apex_tpu.transformer.tensor_parallel.random import (
+            model_parallel_key,
+        )
+
+        key = model_parallel_key(key)
+    return jax.random.randint(key, (), 0, 2 ** 31 - 1, dtype=jnp.int32)
+
+
 class _TPDropout(nn.Module):
     """Dropout whose key folds in the TP rank when the activation is
     sharded over the tensor axis (reference: CudaRNGStatesTracker — TP
@@ -125,11 +140,19 @@ class _TPDropout(nn.Module):
 
     rate: float
     tp_varying: bool = False
+    # Pallas hardware-PRNG dropout (ops/dropout.py): measured ~42 ms ->
+    # ~4 ms per BERT-large step vs the threefry masks of nn.Dropout
+    fused: bool = True
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         if deterministic or self.rate == 0.0:
             return x
+        if self.fused:
+            from apex_tpu.ops.dropout import fused_dropout
+
+            return fused_dropout(x, self.rate,
+                                 _dropout_seed(self, self.tp_varying))
         key = self.make_rng("dropout")
         if self.tp_varying:
             from apex_tpu.transformer.tensor_parallel.random import (
@@ -192,7 +215,6 @@ class BertSelfAttention(nn.Module):
         use_flash = (
             cfg.fused_kernels and cfg.flash_attention
             and q.shape[2] >= cfg.flash_min_seq
-            and (cfg.attention_dropout == 0.0 or deterministic)
             # flash takes a BOOLEAN per-key padding mask; the (B, 1, 1, Sk)
             # convention from BertModel reduces to it exactly. Additive
             # float masks must go through the composed-softmax path.
@@ -207,14 +229,21 @@ class BertSelfAttention(nn.Module):
 
             key_mask = (None if attention_mask is None
                         else attention_mask[:, 0, 0, :])
-            ctx = flash_attention(q, k, v, key_mask, False, inv_sqrt)
+            drop = (0.0 if deterministic else cfg.attention_dropout)
+            # fused in-kernel dropout (reference fmha's Philox path);
+            # heads are sharded under TP, so fold the TP rank in
+            seed = (_dropout_seed(self, cfg.use_tensor_parallel)
+                    if drop > 0.0 else None)
+            ctx = flash_attention(q, k, v, key_mask, False, inv_sqrt,
+                                  drop, seed)
         else:
             scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
                                 preferred_element_type=jnp.float32) * inv_sqrt
             probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
             # attention probs are head-sharded under TP: per-rank masks
             probs = _TPDropout(cfg.attention_dropout,
-                               tp_varying=cfg.use_tensor_parallel)(
+                               tp_varying=cfg.use_tensor_parallel,
+                               fused=cfg.fused_kernels)(
                 probs, deterministic=deterministic)
             ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
                              preferred_element_type=jnp.float32)
@@ -248,7 +277,8 @@ class BertLayer(nn.Module):
         # sequence-sharded under SP (per-rank tokens → per-rank masks);
         # replicated under plain TP (masks must agree across ranks)
         sp = cfg.use_tensor_parallel and cfg.sequence_parallel
-        attn = _TPDropout(cfg.hidden_dropout, tp_varying=sp)(
+        attn = _TPDropout(cfg.hidden_dropout, tp_varying=sp,
+                          fused=cfg.fused_kernels)(
             attn, deterministic=deterministic)
         x = _norm(cfg, "attention_ln")(x + attn)
 
@@ -276,7 +306,8 @@ class BertLayer(nn.Module):
             hmid = _dense(cfg, cfg.intermediate_size, "mlp_in")(x)
             hmid = nn.gelu(hmid)
             mlp = _dense(cfg, cfg.hidden_size, "mlp_out")(hmid)
-        mlp = _TPDropout(cfg.hidden_dropout, tp_varying=sp)(
+        mlp = _TPDropout(cfg.hidden_dropout, tp_varying=sp,
+                         fused=cfg.fused_kernels)(
             mlp, deterministic=deterministic)
         return _norm(cfg, "output_ln")(x + mlp)
 
@@ -308,7 +339,8 @@ class BertEmbeddings(nn.Module):
                        name="token_type_embeddings")(token_type_ids)
         x = word + pos[None, :, :] + typ
         x = _norm(cfg, "ln")(x.astype(cfg.dtype))
-        return nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return _TPDropout(cfg.hidden_dropout, fused=cfg.fused_kernels)(
+            x, deterministic=deterministic)
 
 
 class BertModel(nn.Module):

@@ -7,6 +7,9 @@ kernels (:mod:`apex_tpu.ops.layer_norm`, :mod:`apex_tpu.ops.softmax`,
 """
 
 from apex_tpu.ops import multi_tensor  # noqa: F401
-from apex_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from apex_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_dropout_keep_mask,
+)
 from apex_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from apex_tpu.ops.ulysses_attention import ulysses_attention  # noqa: F401

@@ -18,6 +18,18 @@ def interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def keep_threshold(dropout_rate):
+    """uint32 threshold shared by every fused-dropout kernel: a lane is
+    kept iff its random bits are < this. keep_prob maps onto the full
+    uint32 range so the kept fraction is exact to 2^-32 (the reference
+    Philox kernels use the same compare-against-scaled-keep-prob
+    construction)."""
+    import jax.numpy as jnp
+
+    keep = 1.0 - dropout_rate
+    return jnp.uint32(min(int(keep * 4294967296.0), 4294967295))
+
+
 def use_jnp_fallback(*arrays) -> bool:
     """True when the Pallas interpreter cannot be used: non-TPU backend AND
     inputs varying over shard_map axes (this JAX version's HLO interpreter

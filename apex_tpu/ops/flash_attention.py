@@ -31,10 +31,25 @@ On non-TPU backends the kernels run under ``interpret=True`` (same code
 path, CPU-sim testable); a pure-jnp reference is used under shard_map vma
 on CPU (see ops/_common.py) and for parity tests.
 
-Dropout inside the probability matrix is NOT fused (the composed-softmax
-path covers training-time attention dropout); callers gate on
-``attention_dropout == 0`` — the inference/MLPerf-eval configuration the
-reference fmha kernels target as well.
+Attention dropout is FUSED (the reference fmha kernels generate their
+Philox dropout in-kernel; this is the MLPerf-BERT *training* config):
+- On real TPU the keep-mask is generated in-kernel from the hardware PRNG
+  (``pltpu.prng_seed`` keyed by ``(seed, b, h, iq, ik)`` +
+  ``prng_random_bits``), so no (B, H, Sq, Sk) mask ever touches HBM. The
+  backward pass re-seeds identically per tile and replays the exact mask
+  during recompute.
+- The dropout multiplies the *unnormalized* probability tile only where it
+  feeds the ``p @ v`` accumulation; the online-softmax statistics (m, l,
+  lse) stay pre-dropout, so the math equals composed
+  ``dropout(softmax(s)) @ v`` by linearity of the final ``acc / l``.
+- ``delta = rowsum(dO * O)`` already equals ``rowsum(P_dropped * dP)``
+  when O carries dropout, so the backward needs no extra correction — the
+  keep-mask is simply replayed onto ``dp`` (and onto ``p`` for dv).
+- Interpret mode (CPU sim) has no TPU PRNG; there the same kernels take a
+  precomputed uint32 bits tensor generated host-side from the seed — the
+  identical thresholding math, deterministic across fwd/bwd.
+``flash_dropout_keep_mask`` reproduces the kernel's exact mask on either
+backend so tests can compose a bit-matched reference.
 """
 
 from __future__ import annotations
@@ -49,6 +64,7 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.ops._common import (
     LANE,
     interpret_mode as _interpret,
+    keep_threshold as _keep_threshold,
     match_vma,
     out_struct,
     round_up as _round_up,
@@ -72,12 +88,40 @@ def _prec(dtype):
             else jax.lax.Precision.DEFAULT)
 
 
+def _tile_id(b, h, iq, ik, H, nq, nk):
+    """Injective int32 id of score tile (iq, ik) of head (b, h) — the
+    PRNG seed coordinate shared by fwd/dq/dkv regardless of their own
+    grid iteration order (Mosaic's prng_seed takes at most 2 values, so
+    the coordinates are flattened into one)."""
+    return ((b * H + h) * nq + iq) * nk + ik
+
+
+def _keep_mask(drop_ref, tile_id, bq, bk, dropout_rate, native_prng):
+    """(bq, bk) boolean keep-mask for one score tile.
+
+    native_prng: seed the TPU hardware PRNG with (user seed, tile id) —
+    any kernel regenerates the identical mask for the same tile.
+    Otherwise drop_ref is a precomputed (1, 1, bq, bk) uint32 block
+    (interpret mode)."""
+    if native_prng:
+        pltpu.prng_seed(drop_ref[0], tile_id)
+        bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.uint32)
+    else:
+        bits = drop_ref[0, 0]
+    return bits < _keep_threshold(dropout_rate)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                acc_s, m_s, l_s, *, scale, causal, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, *rest, scale, causal, bq, bk,
+                dropout_rate=0.0, native_prng=True):
+    if dropout_rate > 0.0:
+        drop_ref, o_ref, lse_ref, acc_s, m_s, l_s = rest
+    else:
+        drop_ref, (o_ref, lse_ref, acc_s, m_s, l_s) = None, rest
+    b, hh = pl.program_id(0), pl.program_id(1)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -112,7 +156,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     l_new = alpha * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
     v = v_ref[0, 0]                                # (bk, D)
-    pv = _dot(p.astype(v.dtype), v, ((1,), (0,)), prec)
+    # dropout multiplies only the p @ v path; m/l/lse stay pre-dropout so
+    # the final acc/l equals composed dropout(softmax) @ v by linearity
+    if dropout_rate > 0.0:
+        tid = _tile_id(b, hh, pl.program_id(2), ik, pl.num_programs(1),
+                       pl.num_programs(2), nk)
+        keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate, native_prng)
+        p_av = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+    else:
+        p_av = p
+    pv = _dot(p_av.astype(v.dtype), v, ((1,), (0,)), prec)
     acc_s[:] = acc_s[:] * alpha + pv
     m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
     l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
@@ -130,7 +183,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_s, *, scale, causal, bq, bk):
+                   *rest, scale, causal, bq, bk,
+                   dropout_rate=0.0, native_prng=True):
+    if dropout_rate > 0.0:
+        drop_ref, dq_ref, dq_s = rest
+    else:
+        drop_ref, (dq_ref, dq_s) = None, rest
+    b, hh = pl.program_id(0), pl.program_id(1)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -156,6 +215,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0, 0]                              # (bq, D)
     v = v_ref[0, 0]                                # (bk, D)
     dp = _dot(do, v, ((1,), (1,)), prec)
+    if dropout_rate > 0.0:
+        # replay the forward's exact keep-mask onto dp (dP = mask/keep *
+        # dO·V); delta already carries the dropout through O
+        tid = _tile_id(b, hh, pl.program_id(2), ik, pl.num_programs(1),
+                       pl.num_programs(2), nk)
+        keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate, native_prng)
+        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
     delta = delta_ref[0, 0, 0][:, None]            # (bq, 1)
     ds = p * (dp - delta) * scale                  # (bq, bk)
     dq_s[:] = dq_s[:] + _dot(ds.astype(k.dtype), k, ((1,), (0,)), prec)
@@ -166,7 +232,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, bq, bk):
+                    *rest, scale, causal, bq, bk,
+                    dropout_rate=0.0, native_prng=True):
+    if dropout_rate > 0.0:
+        drop_ref, dk_ref, dv_ref, dk_s, dv_s = rest
+    else:
+        drop_ref, (dk_ref, dv_ref, dk_s, dv_s) = None, rest
+    b, hh = pl.program_id(0), pl.program_id(1)
     iq = pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -191,10 +263,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     p = jnp.exp(s - lse)                           # (bq, bk)
     p = jnp.where(mrow >= 2, 0.0, p)               # padded keys: p exactly 0
     do = do_ref[0, 0]                              # (bq, D)
-    # dv += p^T @ do
-    dv_s[:] = dv_s[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)), prec)
     v = v_ref[0, 0]
     dp = _dot(do, v, ((1,), (1,)), prec)
+    if dropout_rate > 0.0:
+        # seed with (iq, ik) — the same tile coordinates the forward
+        # used — even though this kernel's grid iterates (ik, iq)
+        tid = _tile_id(b, hh, iq, pl.program_id(2), pl.num_programs(1),
+                       nq, pl.num_programs(2))
+        keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate, native_prng)
+        inv_keep = 1.0 / (1.0 - dropout_rate)
+        p_av = jnp.where(keep, p, 0.0) * inv_keep
+        dp = jnp.where(keep, dp, 0.0) * inv_keep
+    else:
+        p_av = p
+    # dv += dropout(p)^T @ do
+    dv_s[:] = dv_s[:] + _dot(p_av.astype(do.dtype), do, ((0,), (0,)), prec)
     delta = delta_ref[0, 0, 0][:, None]
     ds = p * (dp - delta) * scale                  # (bq, bk)
     # dk += ds^T @ q
@@ -215,12 +298,28 @@ def _spec4(bs, D, index_map):
     return pl.BlockSpec((1, 1, bs, D), index_map)
 
 
-def _flash_fwd_call(q, k, v, mask, *, scale, causal, bq, bk):
+def _drop_arg(drop_in, bq, bk, index_map):
+    """(inputs, in_specs) extension for the dropout source: the (1,) SMEM
+    seed for the native-PRNG path, or the blocked uint32 bits tensor for
+    interpret mode."""
+    if drop_in is None:
+        return [], []
+    if drop_in.ndim == 1:  # native path: scalar seed
+        return [drop_in], [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    return [drop_in], [pl.BlockSpec((1, 1, bq, bk), index_map)]
+
+
+def _flash_fwd_call(q, k, v, mask, *, scale, causal, bq, bk,
+                    dropout_rate=0.0, drop_in=None):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     grid = (B, H, Sq // bq, Sk // bk)
+    native = drop_in is not None and drop_in.ndim == 1
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk)
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        dropout_rate=dropout_rate, native_prng=native)
+    extra, extra_specs = _drop_arg(drop_in, bq, bk,
+                                   lambda b, h, iq, ik: (b, h, iq, ik))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -229,7 +328,7 @@ def _flash_fwd_call(q, k, v, mask, *, scale, causal, bq, bk):
             _spec4(bk, D, lambda b, h, iq, ik: (b, h, ik, 0)),
             _spec4(bk, D, lambda b, h, iq, ik: (b, h, ik, 0)),
             pl.BlockSpec((1, 1, bk), lambda b, h, iq, ik: (b, 0, ik)),
-        ],
+        ] + extra_specs,
         out_specs=(
             _spec4(bq, D, lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, iq, ik: (b, h, 0, iq)),
@@ -244,17 +343,22 @@ def _flash_fwd_call(q, k, v, mask, *, scale, causal, bq, bk):
             pltpu.VMEM((bq, LANE), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, mask)
+    )(q, k, v, mask, *extra)
     return out, lse
 
 
-def _flash_bwd_call(q, k, v, mask, do, lse, delta, *, scale, causal, bq, bk):
+def _flash_bwd_call(q, k, v, mask, do, lse, delta, *, scale, causal, bq, bk,
+                    dropout_rate=0.0, drop_in=None):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    native = drop_in is not None and drop_in.ndim == 1
 
+    extra, extra_specs = _drop_arg(drop_in, bq, bk,
+                                   lambda b, h, iq, ik: (b, h, iq, ik))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, dropout_rate=dropout_rate,
+                          native_prng=native),
         grid=(B, H, Sq // bq, Sk // bk),
         in_specs=[
             _spec4(bq, D, lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -264,16 +368,19 @@ def _flash_bwd_call(q, k, v, mask, do, lse, delta, *, scale, causal, bq, bk):
             _spec4(bq, D, lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, iq, ik: (b, h, 0, iq)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, iq, ik: (b, h, 0, iq)),
-        ],
+        ] + extra_specs,
         out_specs=_spec4(bq, D, lambda b, h, iq, ik: (b, h, iq, 0)),
         out_shape=out_struct((B, H, Sq, D), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, mask, do, lse, delta)
+    )(q, k, v, mask, do, lse, delta, *extra)
 
+    extra, extra_specs = _drop_arg(drop_in, bq, bk,
+                                   lambda b, h, ik, iq: (b, h, iq, ik))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, dropout_rate=dropout_rate,
+                          native_prng=native),
         grid=(B, H, Sk // bk, Sq // bq),
         in_specs=[
             _spec4(bq, D, lambda b, h, ik, iq: (b, h, iq, 0)),
@@ -283,7 +390,7 @@ def _flash_bwd_call(q, k, v, mask, do, lse, delta, *, scale, causal, bq, bk):
             _spec4(bq, D, lambda b, h, ik, iq: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, ik, iq: (b, h, 0, iq)),
             pl.BlockSpec((1, 1, 1, bq), lambda b, h, ik, iq: (b, h, 0, iq)),
-        ],
+        ] + extra_specs,
         out_specs=(
             _spec4(bk, D, lambda b, h, ik, iq: (b, h, ik, 0)),
             _spec4(bk, D, lambda b, h, ik, iq: (b, h, ik, 0)),
@@ -297,7 +404,7 @@ def _flash_bwd_call(q, k, v, mask, do, lse, delta, *, scale, causal, bq, bk):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, mask, do, lse, delta)
+    )(q, k, v, mask, do, lse, delta, *extra)
     return dq, dk, dv
 
 
@@ -356,6 +463,57 @@ def _block_sizes(Sq, Sk):
     return (_block_dim(Sq), _block_dim(Sk))
 
 
+def _drop_input(dropout_rate, seed, B, H, Sqp, Skp):
+    """Dropout source array for the kernels: the (1,) int32 seed on real
+    TPU (in-kernel PRNG), or the full precomputed uint32 bits tensor in
+    interpret mode (no TPU PRNG emulation on CPU). Deterministic in the
+    seed, so the backward regenerates the identical bits."""
+    if dropout_rate == 0.0:
+        return None
+    if seed is None:
+        raise ValueError(
+            "flash_attention with dropout_rate > 0 requires dropout_seed "
+            "(an int32 scalar; fold in the training step / layer index)")
+    seed = jnp.asarray(seed, jnp.int32).reshape(())
+    if _interpret():
+        return jax.random.bits(jax.random.PRNGKey(seed),
+                               (B, H, Sqp, Skp), jnp.uint32)
+    return seed.reshape((1,))
+
+
+def flash_dropout_keep_mask(B, H, Sq, Sk, dropout_rate, seed):
+    """The exact (B, H, Sq, Sk) boolean keep-mask the flash kernels apply
+    for this shape/rate/seed — bit-identical to the in-kernel generation
+    on either backend, so tests can run composed attention with the same
+    mask and assert numerical parity with the fused path."""
+    bq, bk = _block_sizes(Sq, Sk)
+    Sqp, Skp = _round_up(Sq, bq), _round_up(Sk, bk)
+    if _interpret():
+        bits = jax.random.bits(
+            jax.random.PRNGKey(jnp.asarray(seed, jnp.int32)),
+            (B, H, Sqp, Skp), jnp.uint32)
+        return (bits < _keep_threshold(dropout_rate))[:, :, :Sq, :Sk]
+
+    def mask_kernel(seed_ref, o_ref):
+        tid = _tile_id(pl.program_id(0), pl.program_id(1),
+                       pl.program_id(2), pl.program_id(3),
+                       pl.num_programs(1), pl.num_programs(2),
+                       pl.num_programs(3))
+        keep = _keep_mask(seed_ref, tid, bq, bk, dropout_rate, True)
+        o_ref[0, 0] = keep.astype(o_ref.dtype)
+
+    keep = pl.pallas_call(
+        mask_kernel,
+        grid=(B, H, Sqp // bq, Skp // bk),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((1, 1, bq, bk),
+                               lambda b, h, iq, ik: (b, h, iq, ik)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sqp, Skp), jnp.float32),
+        interpret=_interpret(),
+    )(jnp.asarray(seed, jnp.int32).reshape((1,)))
+    return (keep > 0.5)[:, :, :Sq, :Sk]
+
+
 def _scores(q, k, key_mask, causal, scale):
     """(B, H, Sq, Sk) fp32 masked scores — shared by every composed path."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -370,16 +528,41 @@ def _scores(q, k, key_mask, causal, scale):
     return s
 
 
-def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0):
-    """Composed-ops reference: materializes (B, H, Sq, Sk) scores."""
+def mha_reference(q, k, v, key_mask=None, causal=False, scale=1.0,
+                  dropout_rate=0.0, dropout_seed=None):
+    """Composed-ops reference: materializes (B, H, Sq, Sk) scores.
+
+    With dropout the mask comes from ``jax.random`` (same distribution as
+    the kernel's hardware PRNG, different bits — use
+    ``flash_dropout_keep_mask`` + ``mha_with_mask_reference`` for
+    bit-matched parity tests)."""
     p = jax.nn.softmax(_scores(q, k, key_mask, causal, scale), axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError(
+                "mha_reference with dropout_rate > 0 requires dropout_seed")
+        keep = jax.random.bernoulli(
+            jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.int32)),
+            1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def mha_with_mask_reference(q, k, v, keep, key_mask=None, causal=False,
+                            scale=1.0, dropout_rate=0.0):
+    """Composed attention with an EXPLICIT keep-mask — pair with
+    ``flash_dropout_keep_mask`` to reproduce the fused path exactly."""
+    p = jax.nn.softmax(_scores(q, k, key_mask, causal, scale), axis=-1)
+    p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention(q, k, v, key_mask=None, causal: bool = False,
-                    scale: float = 1.0):
+                    scale: float = 1.0, dropout_rate: float = 0.0,
+                    dropout_seed=None):
     """Multi-head attention without materializing the score matrix.
 
     Args:
@@ -388,34 +571,52 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
         (the reference's padding-mask convention).
       causal: apply the upper-triangular causal mask in-kernel.
       scale: softmax temperature (typically ``1/sqrt(D)``).
+      dropout_rate: attention-probability dropout, fused in-kernel (the
+        reference fmha's Philox dropout; static Python float).
+      dropout_seed: int32 scalar (may be traced) seeding the in-kernel
+        PRNG; required when ``dropout_rate > 0``. Vary it per step (and
+        per TP rank for head-sharded attention) for fresh masks.
 
     Replaces the reference's ``fmha``/``fast_multihead_attn`` fused
-    attention. Differentiable via the flash recompute backward.
+    attention. Differentiable via the flash recompute backward, which
+    replays the identical dropout mask from the seed.
     """
-    out, _ = _flash_fwd(q, k, v, key_mask, causal, scale)
+    out, _ = _flash_fwd(q, k, v, key_mask, causal, scale, dropout_rate,
+                        dropout_seed)
     return out
 
 
-def _flash_fwd(q, k, v, key_mask, causal, scale):
+def _flash_fwd(q, k, v, key_mask, causal, scale, dropout_rate=0.0,
+               dropout_seed=None):
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "flash_attention with dropout_rate > 0 requires dropout_seed "
+            "(an int32 scalar; fold in the training step / layer index)")
     if use_jnp_fallback(q, k, v, key_mask):
-        out = mha_reference(q, k, v, key_mask, causal, scale)
+        out = mha_reference(q, k, v, key_mask, causal, scale,
+                            dropout_rate, dropout_seed)
         return out, None
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk = _block_sizes(Sq, Sk)
     qp, kp, vp, mask = _pad_inputs(q, k, v, key_mask, bq, bk)
+    drop_in = _drop_input(dropout_rate, dropout_seed, B, H,
+                          qp.shape[2], kp.shape[2])
     out, lse = _flash_fwd_call(qp, kp, vp, mask, scale=scale, causal=causal,
-                               bq=bq, bk=bk)
+                               bq=bq, bk=bk, dropout_rate=dropout_rate,
+                               drop_in=drop_in)
     return out[:, :, :Sq, :D], lse
 
 
-def _flash_vjp_fwd(q, k, v, key_mask, causal, scale):
-    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
-    return out, (q, k, v, key_mask, out, lse)
+def _flash_vjp_fwd(q, k, v, key_mask, causal, scale, dropout_rate,
+                   dropout_seed):
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale, dropout_rate,
+                          dropout_seed)
+    return out, (q, k, v, key_mask, out, lse, dropout_seed)
 
 
 def _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded, g,
-                g_lse=None):
+                g_lse=None, dropout_rate=0.0, dropout_seed=None):
     """Shared recompute backward for both vjps. ``lse_padded`` is the
     kernel's padded-width lse; ``g_lse`` (optional, (B, H, 1, Sq)) is the
     lse cotangent, folded into delta (d lse/d s = p, so
@@ -426,6 +627,8 @@ def _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded, g,
     qp, kp, vp, mask = _pad_inputs(q, k, v, key_mask, bq, bk)
     Sqp = qp.shape[2]
     Dp = qp.shape[3]
+    drop_in = _drop_input(dropout_rate, dropout_seed, B, H,
+                          Sqp, kp.shape[2])
     gp = g
     outp = out
     if (Sqp, Dp) != (Sq, D):
@@ -442,23 +645,29 @@ def _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded, g,
             glp = jnp.pad(g_lse, ((0, 0), (0, 0), (0, 0), (0, Sqp - Sq)))
         delta = delta - glp.astype(jnp.float32)
     dq, dk, dv = _flash_bwd_call(qp, kp, vp, mask, gp, lse_padded, delta,
-                                 scale=scale, causal=causal, bq=bq, bk=bk)
+                                 scale=scale, causal=causal, bq=bq, bk=bk,
+                                 dropout_rate=dropout_rate, drop_in=drop_in)
     return (match_vma(dq[:, :, :Sq, :D].astype(q.dtype), q),
             match_vma(dk[:, :, :Sk, :D].astype(k.dtype), k),
             match_vma(dv[:, :, :Sk, :D].astype(v.dtype), v),
             None)
 
 
-def _flash_vjp_bwd(causal, scale, res, g):
-    q, k, v, key_mask, out, lse = res
+def _flash_vjp_bwd(causal, scale, dropout_rate, res, g):
+    q, k, v, key_mask, out, lse, dropout_seed = res
     if lse is None:  # jnp fallback path: differentiate the reference
         def f(q, k, v):
-            return mha_reference(q, k, v, key_mask, causal, scale)
+            return mha_reference(q, k, v, key_mask, causal, scale,
+                                 dropout_rate, dropout_seed)
 
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp(g)
-        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v), None)
-    return _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse, g)
+        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v),
+                None, None)
+    dq, dk, dv, dmask = _kernel_bwd(causal, scale, q, k, v, key_mask, out,
+                                    lse, g, dropout_rate=dropout_rate,
+                                    dropout_seed=dropout_seed)
+    return dq, dk, dv, dmask, None
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -485,7 +694,12 @@ def flash_attention_with_lse(q, k, v, key_mask=None, causal: bool = False,
     true Sq — the building block for blockwise/ring consumers that merge
     per-block results via log-sum-exp. Differentiable INCLUDING the lse
     output: its cotangent folds into the recompute backward's delta
-    (``delta = rowsum(dO*O) - dlse``; d lse/d s = p)."""
+    (``delta = rowsum(dO*O) - dlse``; d lse/d s = p).
+
+    No dropout here: blockwise lse-merging consumers rescale partial
+    outputs by post-hoc normalizers, which would double-count a dropout
+    already applied per block — ring/Ulysses apply their own dropout at
+    the merged level instead."""
     if use_jnp_fallback(q, k, v, key_mask):
         return _with_lse_reference(q, k, v, key_mask, causal, scale)
     out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)

@@ -142,3 +142,106 @@ def test_flash_attention_with_lse_fwd_bwd():
     gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gk, gr):
         assert _max_err(a, b) < 3e-4
+
+
+# ------------------------------------------------------------- dropout
+
+def test_dropout_parity_with_extracted_mask():
+    """Fused dropout == composed attention using the kernel's OWN
+    keep-mask (flash_dropout_keep_mask reproduces the in-kernel bits
+    exactly on either backend), fwd and bwd."""
+    from apex_tpu.ops.flash_attention import (
+        flash_dropout_keep_mask,
+        mha_with_mask_reference,
+    )
+
+    B, H, S, D = 2, 3, 128, 64
+    rate, seed = 0.1, 1234
+    q, k, v = _mk(B, H, S, S, D)
+    km = jax.random.uniform(jax.random.PRNGKey(9), (B, S)) < 0.2
+    scale = 1.0 / np.sqrt(D)
+
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, km, False, scale, rate, seed))(q, k, v)
+    keep = flash_dropout_keep_mask(B, H, S, S, rate, seed)
+    ref = mha_with_mask_reference(q, k, v, keep, km, False, scale, rate)
+    assert _max_err(out, ref) < 2e-5
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, km, False, scale,
+                                       rate, seed) * 1.3)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_with_mask_reference(q, k, v, keep, km, False,
+                                               scale, rate) * 1.3)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g, gr):
+        assert _max_err(a, b) < 3e-4
+
+
+def test_dropout_parity_unaligned_multiblock():
+    """Dropout mask replay across tile boundaries: unaligned S forces
+    padding, S=640 forces the multi-block online-softmax recurrence."""
+    from apex_tpu.ops.flash_attention import (
+        flash_dropout_keep_mask,
+        mha_with_mask_reference,
+    )
+
+    for (S, causal) in [(100, False), (640, True)]:
+        B, H, D = 1, 2, 64
+        rate, seed = 0.15, 77
+        q, k, v = _mk(B, H, S, S, D, seed=3)
+        scale = 1.0 / np.sqrt(D)
+        out = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, None, causal, scale, rate, seed))(q, k, v)
+        keep = flash_dropout_keep_mask(B, H, S, S, rate, seed)
+        ref = mha_with_mask_reference(q, k, v, keep, None, causal, scale,
+                                      rate)
+        assert _max_err(out, ref) < 2e-5
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, None, causal, scale,
+                                           rate, seed))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_with_mask_reference(q, k, v, keep, None,
+                                                   causal, scale, rate))
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g, gr):
+            assert _max_err(a, b) < 3e-4
+
+
+def test_dropout_mask_statistics_and_seed_sensitivity():
+    """Keep-rate ~= 1-rate; different seeds give different masks; the
+    same seed is deterministic."""
+    from apex_tpu.ops.flash_attention import flash_dropout_keep_mask
+
+    B, H, S = 2, 4, 256
+    rate = 0.1
+    m1 = np.asarray(flash_dropout_keep_mask(B, H, S, S, rate, 5))
+    m2 = np.asarray(flash_dropout_keep_mask(B, H, S, S, rate, 5))
+    m3 = np.asarray(flash_dropout_keep_mask(B, H, S, S, rate, 6))
+    assert (m1 == m2).all()
+    assert (m1 != m3).any()
+    keep_frac = m1.mean()
+    assert abs(keep_frac - (1 - rate)) < 0.01
+
+
+def test_dropout_zero_rate_matches_no_dropout():
+    B, H, S, D = 1, 2, 128, 64
+    q, k, v = _mk(B, H, S, S, D)
+    a = flash_attention(q, k, v, None, False, 0.125)
+    b = flash_attention(q, k, v, None, False, 0.125, 0.0, 3)
+    assert _max_err(a, b) == 0.0
+
+
+def test_dropout_requires_seed():
+    B, H, S, D = 1, 1, 128, 64
+    q, k, v = _mk(B, H, S, S, D)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, None, False, 1.0, 0.1, None))(q, k, v)
