@@ -30,17 +30,6 @@ class AttnMaskType(enum.Enum):
     causal = 2
 
 
-def _apply_causal(x, scale):
-    """Pre-fold the causal mask (as a large-negative fill surviving the
-    kernel's scale multiply) for the combined causal+padding-mask path.
-    Requires scale > 0, same as scaled_masked_softmax's pre-fold (the
-    downstream call validates and raises for scale <= 0)."""
-    sq, sk = x.shape[-2], x.shape[-1]
-    tril = jnp.tril(jnp.ones((sq, sk), bool))
-    fill = jnp.asarray(-30000.0 / scale if scale > 0 else -30000.0, x.dtype)
-    return jnp.where(tril, x, fill)
-
-
 class FusedScaleMaskSoftmax:
     """Callable mirroring the reference module's constructor/forward."""
 
@@ -78,11 +67,10 @@ class FusedScaleMaskSoftmax:
         if self.is_kernel_available(mask, b, np_, sq, sk):
             if self.attn_mask_type == AttnMaskType.causal:
                 if mask is not None:
-                    # the reference asserts mask is None here; applying the
-                    # padding mask before the causal kernel is strictly more
-                    # useful and keeps fused/fallback outputs identical
-                    return scaled_masked_softmax(
-                        _apply_causal(x, scale), mask, scale)
+                    # the reference asserts mask is None here; combining the
+                    # padding mask with the in-kernel causal mask is strictly
+                    # more useful and keeps fused/fallback outputs identical
+                    return scaled_masked_softmax(x, mask, scale, causal=True)
                 return scaled_upper_triang_masked_softmax(x, scale)
             if mask is not None:
                 return scaled_masked_softmax(x, mask, scale)

@@ -158,3 +158,82 @@ def test_transformer_enums_surface():
                                            "encoder_and_decoder"}
     assert {m.name for m in LayerType} == {"encoder", "decoder"}
     assert {m.name for m in AttnType} == {"self_attn", "cross_attn"}
+
+
+@pytest.mark.parametrize("scale", [-2.0, -0.5, 0.0, 1e-6, 1e3])
+def test_scaled_masked_softmax_any_scale_bool(scale):
+    """In-kernel mask application (after the scale multiply, the
+    reference's order) makes every scale valid — negative scales must
+    still mask, not un-mask (the round-1 sign-flip hazard)."""
+    x = _x((2, 2, 8, 64))
+    rng = np.random.RandomState(5)
+    mask = jnp.asarray(rng.rand(2, 1, 8, 64) > 0.6)
+    y = scaled_masked_softmax(x, mask, scale)
+    ref = softmax_reference(x, jnp.broadcast_to(mask, x.shape), scale)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    got = np.asarray(y)
+    assert got[np.broadcast_to(np.asarray(mask), got.shape)].max() < 1e-6
+
+
+def test_scaled_masked_softmax_tiny_scale_fp16():
+    """fp16 x with a scale small enough that the old fill/scale pre-fold
+    would clamp at the dtype min and under-mask; the in-kernel path must
+    stay exact."""
+    x = _x((2, 1, 8, 32)).astype(jnp.float16)
+    rng = np.random.RandomState(7)
+    mask = jnp.asarray(rng.rand(2, 1, 8, 32) > 0.5)
+    y = scaled_masked_softmax(x, mask, 0.01)
+    got = np.asarray(y, np.float32)
+    assert got[np.broadcast_to(np.asarray(mask), got.shape)].max() < 1e-6
+    ref = softmax_reference(x.astype(jnp.float32),
+                            jnp.broadcast_to(mask, x.shape), 0.01)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("scale", [1.0, -1.0])
+def test_scaled_masked_softmax_additive_negative_scale(scale):
+    x = _x((2, 2, 4, 32))
+    mask = jnp.where(_x((2, 1, 4, 32), 3) > 0, 0.0, -1e9).astype(jnp.float32)
+    y = scaled_masked_softmax(x, mask, scale)
+    ref = softmax_reference(x, jnp.broadcast_to(mask, x.shape), scale)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_scaled_masked_softmax_causal_combined():
+    """causal=True + padding mask, incl. a negative scale (the
+    FusedScaleMaskSoftmax causal-with-mask route)."""
+    for scale in (0.7, -0.7):
+        x = _x((2, 2, 16, 16))
+        rng = np.random.RandomState(9)
+        mask = jnp.asarray(rng.rand(2, 1, 1, 16) > 0.7)
+        y = scaled_masked_softmax(x, mask, scale, causal=True)
+        ref = softmax_reference(x, jnp.broadcast_to(mask, x.shape), scale,
+                                causal=True)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_additive_mask_receives_gradient():
+    """A learned additive bias (ALiBi/relative-position style) fed as the
+    float mask must get the softmax-backward cotangent, matching
+    autodiff of the composed reference (regression: the in-kernel route
+    must not orphan the mask input)."""
+    x = _x((2, 2, 4, 32))
+    w = _x((2, 2, 4, 32), 11)
+    bias = jnp.zeros((2, 1, 1, 32), jnp.float32)
+
+    for scale in (1.0, -1.0):
+        def loss(b):
+            return jnp.sum(scaled_masked_softmax(x, b, scale) * w)
+
+        def loss_ref(b):
+            return jnp.sum(softmax_reference(
+                x, jnp.broadcast_to(b, x.shape), scale) * w)
+
+        g = jax.grad(loss)(bias)
+        gr = jax.grad(loss_ref)(bias)
+        assert float(jnp.abs(g).max()) > 0
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-6)
