@@ -3,9 +3,13 @@
 from apex_tpu.contrib.transducer.transducer import (
     TransducerJoint,
     TransducerLoss,
+    transducer_batch_offset,
     transducer_joint,
     transducer_loss,
+    transducer_pack,
+    transducer_unpack,
 )
 
-__all__ = ["TransducerJoint", "TransducerLoss", "transducer_joint",
-           "transducer_loss"]
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_batch_offset",
+           "transducer_joint", "transducer_loss", "transducer_pack",
+           "transducer_unpack"]

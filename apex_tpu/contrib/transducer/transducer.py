@@ -29,6 +29,77 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+# ---------------------------------------------------------------------------
+# packed layout (reference: the csrc kernels' `packed_input`/`pack_output`
+# mode — padding cells removed, per-example segments concatenated)
+# ---------------------------------------------------------------------------
+#
+# Packed cell order matches the reference: example b's valid lattice is the
+# row-major (f_len[b], y_len[b]+1) block starting at batch_offset[b], i.e.
+# packed[batch_offset[b] + t*(y_len[b]+1) + u] == dense[b, t, u].
+# XLA needs static shapes, so the packed buffer has a static capacity
+# (its true occupancy is batch_offset[-1] + last block; slack is zeros) —
+# the caller computes batch_offset = cumsum-exclusive of
+# f_len * (y_len + 1), exactly the reference's helper.
+
+
+def transducer_batch_offset(f_len, y_len):
+    """Exclusive cumulative offsets of each example's packed block
+    (the reference computes this on the host; here it stays traced)."""
+    sizes = f_len.astype(jnp.int32) * (y_len.astype(jnp.int32) + 1)
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)[:-1]])
+
+
+def _packed_coords(packed_size, batch_offset, y_len):
+    """Map packed position p -> (b, t, u). Positions past the true total
+    yield garbage coords — callers mask them with their own validity
+    test (see transducer_pack)."""
+    p = jnp.arange(packed_size, dtype=jnp.int32)
+    # b = index of the last offset <= p
+    b = (jnp.searchsorted(batch_offset, p, side="right") - 1).astype(jnp.int32)
+    b = jnp.clip(b, 0, batch_offset.shape[0] - 1)
+    rem = p - batch_offset[b]
+    width = y_len.astype(jnp.int32)[b] + 1
+    t = rem // width
+    u = rem % width
+    return b, t, u
+
+
+def transducer_pack(dense, f_len, y_len, packed_size, batch_offset=None):
+    """Pack a dense (B, T, U+1, ...) lattice into (packed_size, ...).
+
+    Gather formulation (one packed row reads one dense cell): static
+    shapes, no scatter hazards. Slack rows beyond the true total are
+    zero."""
+    if batch_offset is None:
+        batch_offset = transducer_batch_offset(f_len, y_len)
+    b, t, u = _packed_coords(packed_size, batch_offset, y_len)
+    total = batch_offset[-1] + (f_len.astype(jnp.int32)[-1]
+                                * (y_len.astype(jnp.int32)[-1] + 1))
+    valid = jnp.arange(packed_size) < total
+    out = dense[b, t, u]
+    return jnp.where(valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0)
+
+
+def transducer_unpack(packed, f_len, y_len, T, U1, batch_offset=None,
+                      fill=0.0):
+    """Unpack (packed_size, ...) back to dense (B, T, U1, ...) — T and
+    U1 are static (the dense lattice bounds); padding cells take
+    ``fill``. Inverse of :func:`transducer_pack`."""
+    if batch_offset is None:
+        batch_offset = transducer_batch_offset(f_len, y_len)
+    width = y_len.astype(jnp.int32)[:, None, None] + 1
+    t = jnp.arange(T, dtype=jnp.int32)[None, :, None]
+    u = jnp.arange(U1, dtype=jnp.int32)[None, None, :]
+    p = batch_offset[:, None, None] + t * width + u
+    valid = ((t < f_len.astype(jnp.int32)[:, None, None]) & (u < width))
+    p = jnp.clip(p, 0, packed.shape[0] - 1)
+    out = packed[p]  # (B, T, U1, ...)
+    mask = valid.reshape(valid.shape + (1,) * (out.ndim - 3))
+    return jnp.where(mask, out, fill)
+
+
 def transducer_joint(f, g, f_len=None, g_len=None, relu: bool = False,
                      dropout_rate: float = 0.0, rng=None):
     """Broadcast-add joint: f (B, T, H) + g (B, U+1, H) -> (B, T, U+1, H).
@@ -117,28 +188,53 @@ def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
 
 
 class TransducerJoint:
-    """Reference class-shape veneer."""
+    """Reference class-shape veneer. ``pack_output=True`` returns the
+    packed (packed_size, H) lattice (padding cells removed, reference
+    packed layout); the caller passes ``batch_offset``
+    (:func:`transducer_batch_offset` of ``f_len``/``g_len - 1``) and a
+    static ``packed_size`` capacity (XLA shapes are static; the
+    reference sizes the buffer dynamically on the host)."""
 
     def __init__(self, pack_output: bool = False, relu: bool = False,
                  dropout: float = 0.0):
-        if pack_output:
-            raise NotImplementedError(
-                "packed output is a CUDA-memory optimization; the XLA "
-                "path keeps the dense lattice (see transducer_joint)")
+        self.pack_output = pack_output
         self.relu = relu
         self.dropout = dropout
 
-    def __call__(self, f, g, f_len=None, g_len=None, rng=None):
-        return transducer_joint(f, g, f_len, g_len, self.relu,
-                                self.dropout, rng)
+    def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
+                 packed_size=None, rng=None):
+        dense = transducer_joint(f, g, f_len, g_len, self.relu,
+                                 self.dropout, rng)
+        if not self.pack_output:
+            return dense
+        if f_len is None or g_len is None or packed_size is None:
+            raise ValueError(
+                "pack_output=True requires f_len, g_len, and a static "
+                "packed_size capacity")
+        return transducer_pack(dense, f_len, g_len.astype(jnp.int32) - 1,
+                               packed_size, batch_offset)
 
 
 class TransducerLoss:
-    """Reference class-shape veneer."""
+    """Reference class-shape veneer. ``packed_input=True`` accepts the
+    packed (packed_size, V) log-prob lattice plus ``batch_offset`` and
+    the static ``max_f_len`` (the reference forward's extra packed-mode
+    args); it is unpacked to the dense lattice with a neutral fill and
+    fed to the same scan — padding cells never reach the recursion
+    (masked by f_len/y_len), so packed and dense losses match
+    exactly."""
 
     def __init__(self, packed_input: bool = False):
-        if packed_input:
-            raise NotImplementedError("packed input not supported; dense")
+        self.packed_input = packed_input
 
-    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+    def __call__(self, x, label, f_len, y_len, batch_offset=None,
+                 max_f_len=None, blank_idx: int = 0):
+        if self.packed_input:
+            if max_f_len is None:
+                raise ValueError(
+                    "packed_input=True requires max_f_len (static dense "
+                    "time bound)")
+            U1 = label.shape[1] + 1
+            x = transducer_unpack(x, f_len, y_len, int(max_f_len), U1,
+                                  batch_offset, fill=_NEG_INF)
         return transducer_loss(x, label, f_len, y_len, blank_idx)

@@ -7,6 +7,10 @@ reference's one-kernel-launch property on TPU.
 
 from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad  # noqa: F401
 from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam  # noqa: F401
-from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import (  # noqa: F401
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+    LambState,
+)
 from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, NovoGradState  # noqa: F401
 from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState  # noqa: F401

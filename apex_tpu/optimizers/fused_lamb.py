@@ -119,3 +119,17 @@ class FusedLAMB(FusedOptimizer):
             master=new_master,
         )
         return self._finish_step(skip_if, new_p, new_state, params, state)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedMixedPrecisionLamb(FusedLAMB):
+    """Reference ``apex/optimizers/fused_mixed_precision_lamb.py`` (U):
+    LAMB that keeps fp32 master weights and moments while the model
+    (and its gradients) live in a reduced precision — exactly
+    ``FusedLAMB(master_weights=True)`` here, since this rebuild's LAMB
+    already runs all moment/trust-ratio math in fp32 and casts back to
+    the model dtype (``reduced_precision_dtype`` is therefore inferred
+    from the params rather than configured). Named alias so reference
+    imports resolve."""
+
+    master_weights: bool = True
