@@ -283,8 +283,16 @@ def fused_layer_norm_affine(x, weight, bias, eps: float = 1e-5,
     construction: any floating x with fp32 (or matching) weight/bias;
     output dtype follows x. ``memory_efficient`` is accepted for parity —
     the TPU backward always recomputes statistics (see module docstring).
+
+    Mode-dependent kernel selection (docs/kernels.md measured table):
+    this primal body runs only when the call is NOT being differentiated
+    (inference/serving), where letting XLA fuse the jnp formula into its
+    neighbors beats the standalone Pallas kernel by ~9 ms at BERT-large
+    shapes (a separate kernel is an HBM fusion barrier). Under autodiff,
+    custom_vjp dispatches to ``_ln_affine_fwd`` instead — the Pallas
+    fwd+bwd pair, the measured-best training combination.
     """
-    return _fwd_impl(x, weight, bias, eps, rms=False)
+    return layer_norm_reference(x, weight, bias, eps)
 
 
 def _ln_affine_fwd(x, weight, bias, eps, memory_efficient):
@@ -312,8 +320,10 @@ def fused_rms_norm_affine(x, weight, eps: float = 1e-5,
     """RMSNorm with affine transform, Pallas-fused fwd+bwd.
 
     Reference surface: ``FusedRMSNormAffineFunction`` /
-    ``FusedRMSNormAffineMixedDtypesFunction``."""
-    return _fwd_impl(x, weight, None, eps, rms=True)
+    ``FusedRMSNormAffineMixedDtypesFunction``. Same mode-dependent
+    kernel selection as :func:`fused_layer_norm_affine`: jnp (XLA-fused)
+    when not differentiating, Pallas fwd+bwd under autodiff."""
+    return rms_norm_reference(x, weight, eps)
 
 
 def _rms_affine_fwd(x, weight, eps, memory_efficient):

@@ -33,11 +33,24 @@ _PP_SIZE = 1
 _DP_SIZE = 1
 _EP_SIZE = 1
 _VIRTUAL_PP_SIZE: Optional[int] = None
+_DCN_DP_SIZE = 1
+_DCN_PP_SIZE = 1
+_NUM_SLICES = 1
 
 TENSOR_AXIS = "tensor"
 PIPELINE_AXIS = "pipeline"
 DATA_AXIS = "data"
 EXPERT_AXIS = "expert"
+
+
+def _slice_of(device, world, num_slices):
+    """Slice id of a device: the hardware's ``slice_index`` when the
+    runtime exposes one (real multi-slice TPU), else contiguous
+    device-order partitioning (virtual/CPU simulation)."""
+    idx = getattr(device, "slice_index", None)
+    if idx is not None:
+        return int(idx)
+    return device.id * num_slices // world
 
 
 def initialize_model_parallel(
@@ -47,6 +60,9 @@ def initialize_model_parallel(
     pipeline_model_parallel_split_rank_: Optional[int] = None,
     expert_model_parallel_size_: int = 1,
     *,
+    dcn_data_parallel_size_: int = 1,
+    dcn_pipeline_model_parallel_size_: int = 1,
+    num_slices: Optional[int] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build and install the global mesh (reference:
@@ -63,14 +79,28 @@ def initialize_model_parallel(
     over data only and SHARDED over expert. Gradient sync therefore uses
     :func:`get_data_parallel_group` (→ ``("data", "expert")``) for dense
     params and :func:`get_expert_data_parallel_group` (→ ``"data"``) for
-    expert params."""
+    expert params.
+
+    Multi-slice (DCN) hierarchy (SURVEY.md §2.4 "DCN on outermost axis"):
+    ``dcn_data_parallel_size_`` / ``dcn_pipeline_model_parallel_size_``
+    factor dp and pp into (DCN outer × ICI inner). Devices are grouped by
+    slice (hardware ``slice_index``, or contiguous partitioning for the
+    CPU-sim dryrun via ``num_slices``) and laid out so the OUTERMOST
+    positions of the pipeline/data axes cross slices while tp/ep (and the
+    inner dp/pp factors) stay inside one slice — TP collectives ride ICI;
+    only gradient allreduce / pipeline-boundary hops cross DCN. The axis
+    names are unchanged, so every consumer (TP layers, DDP, schedules)
+    works identically on flat and hybrid meshes."""
     global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE, _VIRTUAL_PP_SIZE
+    global _DCN_DP_SIZE, _DCN_PP_SIZE, _NUM_SLICES
 
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
     tp = int(tensor_model_parallel_size_)
     pp = int(pipeline_model_parallel_size_)
     ep = int(expert_model_parallel_size_)
+    dcn_dp = int(dcn_data_parallel_size_)
+    dcn_pp = int(dcn_pipeline_model_parallel_size_)
     if world % (tp * pp * ep) != 0:
         raise RuntimeError(
             f"world size ({world}) is not divisible by tensor parallel size "
@@ -78,11 +108,50 @@ def initialize_model_parallel(
             f"parallel size ({ep})"
         )
     dp = world // (tp * pp * ep)
-    dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+
+    n_slices = (int(num_slices) if num_slices is not None
+                else dcn_dp * dcn_pp)
+    if dcn_dp * dcn_pp != n_slices:
+        raise RuntimeError(
+            f"dcn_data_parallel_size_ ({dcn_dp}) * "
+            f"dcn_pipeline_model_parallel_size_ ({dcn_pp}) must equal the "
+            f"slice count ({n_slices})")
+    if dp % dcn_dp or pp % dcn_pp:
+        raise RuntimeError(
+            f"dp ({dp}) / pp ({pp}) must be divisible by their DCN "
+            f"factors ({dcn_dp} / {dcn_pp})")
+    if world % n_slices:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by the slice count "
+            f"({n_slices})")
+
+    if n_slices == 1:
+        dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+    else:
+        per_slice = world // n_slices
+        ici_pp, ici_dp = pp // dcn_pp, dp // dcn_dp
+        if ici_pp * ici_dp * ep * tp != per_slice:
+            raise RuntimeError(
+                f"per-slice device count ({per_slice}) != ici_pp * ici_dp "
+                f"* ep * tp ({ici_pp}*{ici_dp}*{ep}*{tp})")
+        groups = [[] for _ in range(n_slices)]
+        for d in devices:
+            groups[_slice_of(d, world, n_slices)].append(d)
+        if any(len(g) != per_slice for g in groups):
+            raise RuntimeError(
+                f"uneven slices: {[len(g) for g in groups]} (expected "
+                f"{per_slice} devices per slice)")
+        dev_array = np.empty((pp, dp, ep, tp), dtype=object)
+        for s, g in enumerate(groups):
+            sp, sd = divmod(s, dcn_dp)   # slice coords on (dcn_pp, dcn_dp)
+            block = np.asarray(g).reshape(ici_pp, ici_dp, ep, tp)
+            dev_array[sp * ici_pp:(sp + 1) * ici_pp,
+                      sd * ici_dp:(sd + 1) * ici_dp] = block
     _MESH = Mesh(dev_array, (PIPELINE_AXIS, DATA_AXIS, EXPERT_AXIS,
                              TENSOR_AXIS))
     _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE = tp, pp, dp, ep
     _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
+    _DCN_DP_SIZE, _DCN_PP_SIZE, _NUM_SLICES = dcn_dp, dcn_pp, n_slices
     return _MESH
 
 
@@ -92,9 +161,11 @@ def model_parallel_is_initialized() -> bool:
 
 def destroy_model_parallel():
     global _MESH, _TP_SIZE, _PP_SIZE, _DP_SIZE, _EP_SIZE, _VIRTUAL_PP_SIZE
+    global _DCN_DP_SIZE, _DCN_PP_SIZE, _NUM_SLICES
     _MESH = None
     _TP_SIZE = _PP_SIZE = _DP_SIZE = _EP_SIZE = 1
     _VIRTUAL_PP_SIZE = None
+    _DCN_DP_SIZE = _DCN_PP_SIZE = _NUM_SLICES = 1
 
 
 def get_mesh() -> Mesh:
@@ -164,6 +235,31 @@ def get_expert_model_parallel_world_size() -> int:
 
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _VIRTUAL_PP_SIZE
+
+
+def get_num_slices() -> int:
+    """Slice count of the hybrid ICI×DCN mesh (1 = flat single-slice)."""
+    return _NUM_SLICES
+
+
+def get_dcn_data_parallel_world_size() -> int:
+    """DCN (outer, cross-slice) factor of the data-parallel axis."""
+    return _DCN_DP_SIZE
+
+
+def get_dcn_pipeline_model_parallel_world_size() -> int:
+    """DCN (outer, cross-slice) factor of the pipeline axis."""
+    return _DCN_PP_SIZE
+
+
+def get_ici_data_parallel_world_size() -> int:
+    """ICI (inner, intra-slice) factor of the data-parallel axis."""
+    return _DP_SIZE // _DCN_DP_SIZE
+
+
+def get_ici_pipeline_model_parallel_world_size() -> int:
+    """ICI (inner, intra-slice) factor of the pipeline axis."""
+    return _PP_SIZE // _DCN_PP_SIZE
 
 
 # -- in-context (traced) ranks --------------------------------------------

@@ -41,6 +41,60 @@ def _axis():
     return parallel_state.PIPELINE_AXIS
 
 
+def _size_of(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def pack_carry(x, carry_struct):
+    """Pack an arbitrary-shaped stage boundary value into the fixed
+    pipeline carry buffer (the shape-negotiation half the reference does
+    with ``_communicate``'s shape handshake — SURVEY §2.3 PP row: NCCL
+    can negotiate shapes per hop; an SPMD scan carry cannot, so
+    shape-CHANGING stages flatten/pad into a carry sized for the largest
+    boundary instead).
+
+    Same-kind payloads (float into a float carry, int into an int carry)
+    round-trip via ``astype`` (exact when the carry dtype is at least as
+    wide); cross-kind payloads are BIT-cast, which requires a 4-byte
+    carry dtype (f32/i32) — a 2-byte carry with an int payload raises
+    rather than corrupting token ids."""
+    flat = x.reshape(-1)
+    x_int = jnp.issubdtype(x.dtype, jnp.integer)
+    c_int = jnp.issubdtype(carry_struct.dtype, jnp.integer)
+    if x_int == c_int:
+        flat = flat.astype(carry_struct.dtype)
+    else:
+        if jnp.dtype(carry_struct.dtype).itemsize != 4:
+            raise ValueError(
+                f"pack_carry: cross-kind payload ({x.dtype} into "
+                f"{carry_struct.dtype} carry) needs a 4-byte carry dtype "
+                "(f32/i32) for a lossless bitcast")
+        src = jnp.int32 if x_int else jnp.float32
+        flat = jax.lax.bitcast_convert_type(flat.astype(src),
+                                            carry_struct.dtype)
+    size = _size_of(carry_struct.shape)
+    if flat.size > size:
+        raise ValueError(
+            f"pack_carry: value of shape {x.shape} ({flat.size} elems) "
+            f"exceeds the carry capacity {carry_struct.shape} ({size})")
+    return jnp.pad(flat, (0, size - flat.size)).reshape(carry_struct.shape)
+
+
+def unpack_carry(carry, shape, dtype):
+    """Inverse of :func:`pack_carry`: slice the leading elements of the
+    carry buffer back into ``(shape, dtype)``."""
+    flat = carry.reshape(-1)[:_size_of(shape)]
+    d_int = jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+    c_int = jnp.issubdtype(carry.dtype, jnp.integer)
+    if d_int == c_int:
+        return flat.astype(dtype).reshape(shape)
+    dst = jnp.int32 if d_int else jnp.float32
+    return jax.lax.bitcast_convert_type(flat, dst).astype(dtype).reshape(shape)
+
+
 def _shift_right(x, axis_name, pp):
     """Send to stage s+1; stage 0 receives stage pp-1's value (ignored)."""
     from apex_tpu.transformer.pipeline_parallel import p2p_communication
@@ -104,6 +158,7 @@ def spmd_pipeline(
     num_microbatches: int,
     remat: bool = True,
     axis_name: Optional[str] = None,
+    carry_struct: Optional[jax.ShapeDtypeStruct] = None,
 ):
     """Run a pipelined forward pass.
 
@@ -124,16 +179,27 @@ def spmd_pipeline(
       (valid there; other stages hold garbage — reduce over the axis or
       read stage pp-1's shard).
 
-    Constraint (differs from the reference's shape-negotiating
-    ``_communicate``): the scan carry is fixed to the microbatch
-    shape/dtype, so ``stage_fn`` must be shape- and dtype-preserving.
-    Shape-changing stages (token ids → embeddings, hidden → logits) must
-    fold the change inside one stage (embed at the top of stage 0's fn,
-    project at the bottom of the last stage's, switched on
-    ``axis_index``). Violations raise immediately with the offending
+    Shape-changing pipelines (the reference's ``_communicate`` negotiates
+    shapes per NCCL hop; a scan carry cannot): pass ``carry_struct``, a
+    ``jax.ShapeDtypeStruct`` sized for the LARGEST stage boundary. Then
+    ``microbatches`` entries and every ``stage_fn`` output must be
+    carry-shaped — use :func:`pack_carry` / :func:`unpack_carry` at each
+    boundary (embedding ids → hidden → logits all travel in the one
+    padded buffer; each stage unpacks the shape it knows, switched on
+    ``axis_index``). Without ``carry_struct`` the carry is the
+    microbatch shape/dtype and ``stage_fn`` must be shape- and
+    dtype-preserving; violations raise immediately with the offending
     shapes rather than an opaque scan carry-type error.
     """
     axis = axis_name or _axis()
+    if carry_struct is not None and (
+            tuple(microbatches.shape[1:]) != tuple(carry_struct.shape)
+            or microbatches.dtype != carry_struct.dtype):
+        raise ValueError(
+            f"with carry_struct {carry_struct.shape}/{carry_struct.dtype}, "
+            f"microbatches must be pre-packed to that shape (got "
+            f"{microbatches.shape[1:]}/{microbatches.dtype}); use "
+            "pack_carry on each microbatch")
     pp = parallel_state.get_pipeline_model_parallel_world_size()
     stage = jax.lax.axis_index(axis)
     fn = jax.checkpoint(stage_fn) if remat else stage_fn

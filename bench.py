@@ -176,6 +176,225 @@ def _measure(batch, seq, iters, with_baseline=True, remat=True):
     return dt_opt, dt_base, mfu
 
 
+def _chain_time(step, state, iters, warmup=2):
+    """Bench-style reliable timing: state evolves through every call
+    (defeats any runtime result caching), block once at the end."""
+    for _ in range(warmup):
+        state = step(*state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(*state)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_layer_norm():
+    """BASELINE configs[1]: FusedLayerNorm (Pallas training path) vs
+    stock-XLA LN, fwd+bwd at the BERT-large shape. Value = speedup (x)."""
+    from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+    x0 = jax.random.normal(jax.random.PRNGKey(0), (16 * 512, 1024),
+                           jnp.bfloat16)
+    w = jnp.ones((1024,), jnp.float32)
+    b = jnp.zeros((1024,), jnp.float32)
+
+    from apex_tpu.ops.layer_norm import layer_norm_reference as stock_ln
+
+    def mk(fn):
+        @jax.jit
+        def step(x):
+            dx = jax.grad(lambda x: jnp.sum(fn(x, w, b).astype(jnp.float32)
+                                            ** 2))(x)
+            return (x - 1e-6 * dx.astype(x.dtype),)
+        return step
+
+    # 64 LN applications per timed call (amortizes dispatch); per-call
+    # time still chains through x
+    def rep(fn):
+        def many(x, w, b):
+            for _ in range(8):
+                x = fn(x, w, b) + x * 0.5
+            return x
+        return many
+
+    dt_fused = _chain_time(mk(rep(fused_layer_norm_affine)), (x0,), iters=8)
+    dt_stock = _chain_time(mk(rep(stock_ln)), (x0,), iters=8)
+    return {
+        "metric": "fused_layer_norm_fwdbwd_speedup_vs_xla",
+        "value": round(dt_stock / dt_fused, 3),
+        "unit": "x",
+        "vs_baseline": round(dt_stock / dt_fused, 3),
+    }
+
+
+def bench_fused_lamb():
+    """BASELINE configs[2]: FusedLAMB (multi_tensor flat-fusion step)
+    vs a per-leaf unfused update chain, on a ResNet-50-class param set
+    (~25.6M params, 161 leaves). Value = speedup (x)."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    rng = np.random.RandomState(0)
+    leaves = {}
+    # ResNet-50-ish spectrum: many small conv/bn leaves + a few big ones
+    for i in range(53):
+        leaves[f"conv{i}"] = jnp.asarray(
+            rng.randn(*(3, 3, 128, 256 if i % 3 else 512)).astype("f4") * .01)
+    for i in range(106):
+        leaves[f"bn{i}"] = jnp.asarray(rng.randn(512).astype("f4"))
+    leaves["fc"] = jnp.asarray(rng.randn(2048, 1000).astype("f4") * .01)
+    grads = jax.tree.map(lambda p: p * 0.01, leaves)
+    n = sum(l.size for l in jax.tree.leaves(leaves))
+
+    opt = FusedLAMB(lr=1e-3)
+
+    @jax.jit
+    def fused_step(params, ost):
+        p2, ost2 = opt.step(grads, ost, params)
+        return p2, ost2
+
+    def eager_step_body(params, m, v, step):
+        # per-leaf unfused chain: the torch-eager per-param analog
+        step = step + 1
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            g = grads[k]
+            m_k = 0.9 * m[k] + 0.1 * g
+            v_k = 0.999 * v[k] + 0.001 * g * g
+            mh = m_k / (1 - 0.9 ** step)
+            vh = v_k / (1 - 0.999 ** step)
+            upd = mh / (jnp.sqrt(vh) + 1e-6) + 0.01 * params[k]
+            tn = jnp.linalg.norm(params[k])
+            un = jnp.linalg.norm(upd)
+            trust = jnp.where((tn > 0) & (un > 0), tn / un, 1.0)
+            new_p[k] = params[k] - 1e-3 * trust * upd
+            new_m[k], new_v[k] = m_k, v_k
+        return new_p, new_m, new_v, step
+
+    eager_step = jax.jit(eager_step_body)
+
+    ost0 = opt.init(leaves)
+    dt_fused = _chain_time(fused_step, (leaves, ost0), iters=20)
+    zeros = jax.tree.map(jnp.zeros_like, leaves)
+    dt_eager = _chain_time(eager_step,
+                           (leaves, zeros, zeros, jnp.int32(0)), iters=20)
+    return {
+        "metric": "fused_lamb_step_speedup_vs_per_leaf_eager",
+        "value": round(dt_eager / dt_fused, 3),
+        "unit": "x",
+        "vs_baseline": round(dt_eager / dt_fused, 3),
+        "n_params": n,
+    }
+
+
+_DDP_SCALING_CHILD = r"""
+import json, time, sys
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import PartitionSpec as P
+
+dp = int(sys.argv[1])
+sync = sys.argv[2] == "sync"  # nosync: same step minus the grad allreduce
+import apex_tpu  # noqa: F401
+from apex_tpu.parallel import DistributedDataParallel, SyncBatchNorm
+import flax.linen as nn
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        for i in range(4):
+            x = nn.Conv(32, (3, 3), use_bias=False)(x)
+            x = SyncBatchNorm(num_features=32, axis_name="data",
+                              channel_last=True)(
+                x, use_running_average=not train)
+            x = nn.relu(x)
+        return jnp.mean(x, axis=(1, 2)) @ jnp.ones((32, 1))
+
+net = Net()
+ddp = DistributedDataParallel(axis_name="data")
+mesh = jax.make_mesh((dp,), ("data",), devices=jax.devices()[:dp])
+rng = np.random.RandomState(0)
+xb = jnp.asarray(rng.randn(dp * 8, 16, 16, 3).astype("f4"))
+yb = jnp.asarray(rng.randn(dp * 8, 1).astype("f4"))
+
+def init_fn(x):
+    return net.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+def train_step(variables, x, y):
+    def loss_fn(p):
+        out, mut = net.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean((out - y) ** 2), mut
+    (loss, mut), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables["params"])
+    if sync:
+        g = ddp.allreduce_grads(g)
+    p2 = jax.tree.map(lambda p, gg: p - 1e-3 * gg, variables["params"], g)
+    return {"params": p2, "batch_stats": mut["batch_stats"]}
+
+variables = jax.jit(jax.shard_map(
+    init_fn, mesh=mesh, in_specs=P("data"), out_specs=P()))(xb)
+step = jax.jit(jax.shard_map(
+    train_step, mesh=mesh, in_specs=(P(), P("data"), P("data")),
+    out_specs=P()))
+for _ in range(5):
+    variables = step(variables, xb, yb)
+jax.block_until_ready(variables)
+best = None
+for _ in range(3):  # best-of-3 windows: shared-core CPU sim is noisy
+    t0 = time.perf_counter()
+    for _ in range(20):
+        variables = step(variables, xb, yb)
+    jax.block_until_ready(variables)
+    dt = (time.perf_counter() - t0) / 20
+    best = dt if best is None else min(best, dt)
+print(json.dumps({"dt": best}))
+"""
+
+
+def bench_ddp_scaling():
+    """BASELINE configs[3] (virtual-device proxy for the 8->64->256 pod
+    sweep, which needs hardware this harness doesn't have): the
+    framework-attributable cost of DDP+SyncBN synchronization at dp=8 —
+    step time WITHOUT the grad allreduce over step time WITH it, ideal
+    1.0 (see the NOTE below on why wall-clock weak scaling is not
+    measurable on a shared-core virtual mesh)."""
+    import os
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+
+    def run(mode, dp=8):
+        out = subprocess.run(
+            [sys.executable, "-c", _DDP_SCALING_CHILD, str(dp), mode],
+            capture_output=True, text=True, timeout=600, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr[-500:])
+        return json.loads(out.stdout.strip().splitlines()[-1])["dt"]
+
+    # NOTE on the metric definition: true 8->64->256 weak scaling needs
+    # pod hardware this harness doesn't have, and on the virtual CPU
+    # mesh all "devices" share one host's cores, so wall-clock weak
+    # scaling would measure the host, not the framework. The framework-
+    # attributable quantity IS measurable: the step-time overhead the
+    # DDP+SyncBN gradient/stat synchronization adds at dp=8 (sync step
+    # vs the identical step with the grad allreduce removed).
+    dt_sync = run("sync")
+    dt_nosync = run("nosync")
+    # clamp: >1 means the sync overhead is below CPU-sim timing noise
+    eff = min(dt_nosync / dt_sync, 1.0)
+    return {
+        "metric": "ddp_syncbn_grad_sync_efficiency_8dev_cpu_sim",
+        "value": round(eff, 3),
+        "unit": "ratio",
+        "vs_baseline": round(eff, 3),
+    }
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     # Headline: the BASELINE seq-512-class pretraining shape. With the
@@ -202,6 +421,15 @@ def main():
         "vs_baseline": round(dt_base / dt_opt, 3),
     }
     print(json.dumps(result))
+    # BASELINE configs[1]-[3] as machine-readable regression records
+    # (previously prose in docs/kernels.md only)
+    _reset()
+    for bench_fn in (bench_layer_norm, bench_fused_lamb, bench_ddp_scaling):
+        try:
+            print(json.dumps(bench_fn()))
+        except Exception as e:  # a secondary metric must not kill the run
+            print(f"# {bench_fn.__name__} failed: {e}", file=sys.stderr)
+        _reset()
 
 
 if __name__ == "__main__":

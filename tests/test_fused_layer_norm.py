@@ -31,7 +31,7 @@ def test_layer_norm_forward_matches_reference(shape):
     x = _data(shape)
     w = _data((shape[-1],), 1) * 0.1 + 1.0
     b = _data((shape[-1],), 2) * 0.1
-    y = fused_layer_norm_affine(x, w, b)
+    y, _ = jax.vjp(lambda x: fused_layer_norm_affine(x, w, b), x)
     ref = layer_norm_reference(x, w, b)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
@@ -74,7 +74,7 @@ def test_mixed_dtype_bf16_input_fp32_weight():
     x = _data((16, 256), dtype=jnp.bfloat16)
     w = _data((256,), 1) * 0.1 + 1.0
     b = _data((256,), 2) * 0.1
-    y = fused_layer_norm_affine(x, w, b)
+    y, _ = jax.vjp(lambda x: fused_layer_norm_affine(x, w, b), x)
     assert y.dtype == jnp.bfloat16
     ref = layer_norm_reference(x, w, b)
     np.testing.assert_allclose(
@@ -144,7 +144,10 @@ def test_under_jit_and_odd_rows():
     x = _data((17, 160))
     w = jnp.ones((160,))
     b = jnp.zeros((160,))
-    y = jax.jit(lambda x: fused_layer_norm_affine(x, w, b))(x)
+    # vjp so the Pallas training forward runs (the undifferentiated
+    # primal is the jnp inference path since the mode-selection change)
+    y, _ = jax.jit(lambda x: jax.vjp(
+        lambda x: fused_layer_norm_affine(x, w, b), x))(x)
     np.testing.assert_allclose(
         np.asarray(y), np.asarray(layer_norm_reference(x, w, b)), rtol=1e-5, atol=1e-5
     )
@@ -159,7 +162,7 @@ def test_large_prime_row_count_stays_block_tiled():
     x = _data((3, 4097, 128))  # 12291 rows
     w = jnp.ones((128,))
     b = jnp.zeros((128,))
-    y = fused_layer_norm_affine(x, w, b)
+    y, _ = jax.vjp(lambda x: fused_layer_norm_affine(x, w, b), x)
     assert y.shape == x.shape
     ref = layer_norm_reference(x, w, b)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
@@ -183,7 +186,34 @@ def test_block_rows_shrink_for_wide_hidden():
     x = _data((64, 8192))
     w = jnp.ones((8192,))
     b = jnp.zeros((8192,))
-    y = fused_layer_norm_affine(x, w, b)
+    y, _ = jax.vjp(lambda x: fused_layer_norm_affine(x, w, b), x)
     ref = layer_norm_reference(x, w, b)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_mode_dependent_selection_agrees():
+    """The inference primal (XLA-fused jnp, docs/kernels.md measured
+    default) and the training fwd (Pallas kernel) must agree numerically
+    — the mode switch is a perf choice, not a semantics one."""
+    import jax
+
+    from apex_tpu.ops.layer_norm import (
+        fused_layer_norm_affine,
+        fused_rms_norm_affine,
+    )
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 640).astype("float32"))
+    w = jnp.asarray(rng.randn(640).astype("float32"))
+    b = jnp.asarray(rng.randn(640).astype("float32"))
+
+    infer = fused_layer_norm_affine(x, w, b)          # primal body
+    train, _ = jax.vjp(lambda x: fused_layer_norm_affine(x, w, b), x)
+    np.testing.assert_allclose(np.asarray(infer), np.asarray(train),
+                               rtol=1e-5, atol=1e-5)
+
+    infer_r = fused_rms_norm_affine(x, w)
+    train_r, _ = jax.vjp(lambda x: fused_rms_norm_affine(x, w), x)
+    np.testing.assert_allclose(np.asarray(infer_r), np.asarray(train_r),
+                               rtol=1e-5, atol=1e-5)

@@ -195,3 +195,123 @@ def test_p2p_send_backward_ring():
         jax.shard_map(f, mesh=mesh, in_specs=P("pipeline"), out_specs=P("pipeline"))
     )(x)
     np.testing.assert_allclose(np.asarray(out), [1.0, 2.0, 3.0, 0.0])
+
+
+def test_shape_changing_pipeline_embed_block_logits():
+    """Shape-NEGOTIATING pipeline (reference _communicate handshake):
+    token ids -> embeddings -> hidden blocks -> logits travel through
+    one fixed carry buffer via pack_carry/unpack_carry; the pipelined
+    loss must equal the unpipelined model's loss."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pack_carry,
+        unpack_carry,
+    )
+    from apex_tpu.utils.collectives import mark_varying
+
+    V, S = 11, 4  # vocab, seq
+    rng = np.random.RandomState(3)
+    embed_t = jnp.asarray(rng.randn(V, H).astype("f4") * 0.5)
+    w1 = jnp.asarray(rng.randn(H, H).astype("f4") * 0.3)
+    w2 = jnp.asarray(rng.randn(H, H).astype("f4") * 0.3)
+    out_w = jnp.asarray(rng.randn(H, V).astype("f4") * 0.3)
+    ids = jnp.asarray(rng.randint(0, V, (M, MB, S)))
+    targets = jnp.asarray(rng.randint(0, V, (M, MB, S)))
+
+    # carry sized for the largest boundary: logits (MB, S, V)
+    struct = jax.ShapeDtypeStruct((MB, S, max(V, H)), jnp.float32)
+    params = {"embed": embed_t, "w1": w1, "w2": w2, "out": out_w}
+
+    def stage_fn(p, carry, mb_idx):
+        stage = jax.lax.axis_index("pipeline")
+
+        def do_embed(c):
+            toks = unpack_carry(c, (MB, S), jnp.int32)
+            return pack_carry(p["embed"][toks], struct)
+
+        def do_block(w):
+            def f(c):
+                h = unpack_carry(c, (MB, S, H), jnp.float32)
+                return pack_carry(jnp.tanh(h @ w), struct)
+            return f
+
+        def do_logits(c):
+            h = unpack_carry(c, (MB, S, H), jnp.float32)
+            return pack_carry(h @ p["out"], struct)
+
+        return jax.lax.switch(
+            stage, [do_embed, do_block(p["w1"]), do_block(p["w2"]),
+                    do_logits], carry)
+
+    def loss_fn(carry, mb_idx, targets):
+        logits = unpack_carry(carry, (MB, S, V), jnp.float32)
+        t = jax.lax.dynamic_index_in_dim(targets, mb_idx, keepdims=False)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, t[..., None], -1))
+
+    def f(params, ids, targets):
+        packed = jax.vmap(lambda mb: pack_carry(mb, struct))(ids)
+        outs = spmd_pipeline(stage_fn, params, packed,
+                             num_microbatches=M, carry_struct=struct)
+        per_mb = jax.vmap(lambda o, i: loss_fn(o, i, targets))(
+            outs, jnp.arange(M))
+        local = jnp.mean(per_mb)
+        stage = jax.lax.axis_index("pipeline")
+        return jax.lax.psum(jnp.where(stage == PP - 1, local, 0.0),
+                            "pipeline")
+
+    loss = _run_sharded(f, params, ids, targets,
+                        in_specs=(P(), P(), P()), out_specs=P())
+
+    # unpipelined reference
+    h = embed_t[ids]
+    h = jnp.tanh(h @ w1)
+    h = jnp.tanh(h @ w2)
+    logits = h @ out_w
+    logp = jax.nn.log_softmax(logits, -1)
+    ref = -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+def test_carry_struct_validates_packing():
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pack_carry,
+        unpack_carry,
+    )
+
+    struct = jax.ShapeDtypeStruct((MB, 8), jnp.float32)
+    with pytest.raises(ValueError, match="pre-packed"):
+        def g(xs):
+            return spmd_pipeline(lambda p, x, i: x, None, xs,
+                                 num_microbatches=M, carry_struct=struct)
+        _run_sharded(g, _batches(), in_specs=(P(),), out_specs=P("pipeline"))
+    with pytest.raises(ValueError, match="exceeds the carry"):
+        pack_carry(jnp.zeros((MB, 99)), struct)
+    # int round-trip is exact through the float carry
+    ids = jnp.asarray(np.random.RandomState(0).randint(-5, 2 ** 30, (4, 3)))
+    back = unpack_carry(pack_carry(ids, jax.ShapeDtypeStruct((13,),
+                                                             jnp.float32)),
+                        (4, 3), ids.dtype)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(ids))
+
+
+def test_pack_carry_int32_carry_roundtrip():
+    """Same-kind (int->int) carries astype; cross-kind bitcasts; 2-byte
+    carries with int payloads are rejected (review regression: the
+    docstring-recommended i32 carry corrupted ids via a value-cast)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pack_carry,
+        unpack_carry,
+    )
+
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(-7, 2 ** 30, (4, 3)))
+    i32 = jax.ShapeDtypeStruct((13,), jnp.int32)
+    back = unpack_carry(pack_carry(ids, i32), (4, 3), ids.dtype)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(ids))
+    # float through an int carry: bitcast round-trip
+    xs = jnp.asarray(rng.randn(5).astype("f4"))
+    back_f = unpack_carry(pack_carry(xs, i32), (5,), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(back_f), np.asarray(xs))
+    # 2-byte carry with int payload: loud rejection
+    with pytest.raises(ValueError, match="4-byte"):
+        pack_carry(ids, jax.ShapeDtypeStruct((13,), jnp.bfloat16))

@@ -432,3 +432,82 @@ def test_vocab_utility_and_split_helpers():
     assert jnp.array_equal(jnp.concatenate(chunks, axis=-1), x)
     with pytest.raises(ValueError):
         split_tensor_along_last_dim(x, 5)
+
+
+# ---------------------------------------------------- hybrid DCN mesh
+
+def test_hybrid_mesh_two_slices_tp_stays_on_ici():
+    """2 simulated slices on the 8 virtual devices, dcn-dp outermost:
+    every TP pair must live inside ONE slice (TP rides ICI), and the
+    outer half of the data axis must cross slices (grad allreduce rides
+    DCN), per SURVEY §2.4."""
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2,
+        dcn_data_parallel_size_=2, num_slices=2)
+    assert parallel_state.get_num_slices() == 2
+    assert parallel_state.get_dcn_data_parallel_world_size() == 2
+    assert parallel_state.get_ici_data_parallel_world_size() == 2
+    assert mesh.shape == {"pipeline": 1, "data": 4, "expert": 1,
+                          "tensor": 2}
+    world = 8
+    devs = mesh.devices  # (pp, dp, ep, tp)
+
+    def slice_of(d):
+        return d.id * 2 // world  # matches the simulated partitioning
+
+    # TP pairs: same slice
+    for idp in range(4):
+        pair = devs[0, idp, 0, :]
+        assert slice_of(pair[0]) == slice_of(pair[1])
+    # data axis: inner half (rows 0-1) slice 0, outer half (rows 2-3)
+    # slice 1 — the DCN factor is the outer positions
+    row_slices = [slice_of(devs[0, idp, 0, 0]) for idp in range(4)]
+    assert row_slices == [0, 0, 1, 1]
+
+
+def test_hybrid_mesh_dcn_pipeline_outermost():
+    """dcn-pp=2: pipeline stages split across slices with ICI stages
+    contiguous inside each slice."""
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        dcn_pipeline_model_parallel_size_=2, num_slices=2)
+    assert parallel_state.get_ici_pipeline_model_parallel_world_size() == 2
+    devs = mesh.devices
+
+    def slice_of(d):
+        return d.id * 2 // 8
+
+    stage_slices = [slice_of(devs[ipp, 0, 0, 0]) for ipp in range(4)]
+    assert stage_slices == [0, 0, 1, 1]
+
+
+def test_hybrid_mesh_validation():
+    parallel_state.destroy_model_parallel()
+    with pytest.raises(RuntimeError, match="slice count"):
+        parallel_state.initialize_model_parallel(
+            dcn_data_parallel_size_=2, num_slices=4)
+    with pytest.raises(RuntimeError, match="divisible by their DCN"):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=4,  # dp=2
+            dcn_data_parallel_size_=3, num_slices=3)
+
+
+def test_hybrid_mesh_ddp_step_runs():
+    """A DDP-style psum gradient sync compiles and runs over the hybrid
+    mesh — the 'data' axis spans both slices transparently."""
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2,
+        dcn_data_parallel_size_=2, num_slices=2)
+
+    def f(g):
+        return jax.lax.pmean(g, "data")
+
+    g = jnp.arange(8.0).reshape(4, 2)
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("data", "tensor"),
+        out_specs=P("data", "tensor")))(g)
+    cols = np.asarray(out).reshape(4, 2)
+    np.testing.assert_allclose(cols, np.tile(np.asarray(g).mean(0), (4, 1)))
