@@ -43,15 +43,10 @@ class AmpHandle:
     def init_state(self, loss_id: int = 0) -> ScalerState:
         return self.scalers[loss_id].init()
 
-    def value_and_grad(self, loss_fn, state: ScalerState, loss_id: int = 0,
-                       has_aux: bool = False):
-        """Scaled value_and_grad; see :meth:`LossScaler.value_and_grad`.
-
-        If this handle's opt level patches functions (O1), the loss_fn is
-        traced under the autocast context so whitelist/blacklist casts bake
-        into the jaxpr.
-        """
-        scaler = self.scalers[loss_id]
+    def _traced(self, loss_fn):
+        """Trace loss_fn under autocast when this opt level patches
+        functions (O1), so whitelist/blacklist casts bake into the
+        jaxpr."""
 
         def traced(*args, **kwargs):
             if self._properties.patch_torch_functions:
@@ -59,7 +54,22 @@ class AmpHandle:
                     return loss_fn(*args, **kwargs)
             return loss_fn(*args, **kwargs)
 
-        return scaler.value_and_grad(traced, state, has_aux=has_aux)
+        return traced
+
+    def value_and_grad(self, loss_fn, state: ScalerState, loss_id: int = 0,
+                       has_aux: bool = False):
+        """Scaled value_and_grad; see :meth:`LossScaler.value_and_grad`."""
+        return self.scalers[loss_id].value_and_grad(
+            self._traced(loss_fn), state, has_aux=has_aux)
+
+    def scaled_value_and_grad(self, loss_fn, state: ScalerState,
+                              loss_id: int = 0, has_aux: bool = False):
+        """Like :meth:`value_and_grad` but returns SCALED grads with no
+        unscale pass — for the fused-tail flow where the optimizer
+        unscales during its own first read
+        (``opt.step(grads, ..., grad_scale=loss_scale)``)."""
+        return self.scalers[loss_id].scaled_value_and_grad(
+            self._traced(loss_fn), state, has_aux=has_aux)
 
     def scale_loss(self, loss, state: ScalerState, loss_id: int = 0):
         """Scale a loss value (enter half of the reference context manager)."""

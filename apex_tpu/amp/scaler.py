@@ -183,6 +183,28 @@ class LossScaler:
 
             (loss, found_inf, aux), grads = scaler.value_and_grad(f, st)(params)
         """
+        scaled_vg = self.scaled_value_and_grad(loss_fn, state,
+                                               has_aux=has_aux)
+
+        def wrapped(*args, **kwargs):
+            out, scaled_grads = scaled_vg(*args, **kwargs)
+            loss, aux = out if has_aux else (out, None)
+            grads, found_inf = self.unscale(scaled_grads, state)
+            if has_aux:
+                return (loss, found_inf, aux), grads
+            return (loss, found_inf), grads
+
+        return wrapped
+
+    def scaled_value_and_grad(self, loss_fn, state: ScalerState,
+                              has_aux: bool = False):
+        """``jax.value_and_grad`` of the scaled loss returning the SCALED
+        gradients and unscaled loss — no unscale pass and no finite check
+        here. Pair with an optimizer that folds the unscale into its own
+        first gradient read (``FusedLAMB.step(grad_scale=...)``): one
+        fewer full read+write of the gradient tree per step than
+        :meth:`value_and_grad` + separate ``unscale``, with the overflow
+        check riding the optimizer's existing global-norm reduction."""
 
         def scaled_fn(*args, **kwargs):
             out = loss_fn(*args, **kwargs)
@@ -196,10 +218,9 @@ class LossScaler:
 
         def wrapped(*args, **kwargs):
             (_, (loss, aux)), scaled_grads = vg(*args, **kwargs)
-            grads, found_inf = self.unscale(scaled_grads, state)
             if has_aux:
-                return (loss, found_inf, aux), grads
-            return (loss, found_inf), grads
+                return (loss, aux), scaled_grads
+            return loss, scaled_grads
 
         return wrapped
 

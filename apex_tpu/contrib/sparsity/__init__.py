@@ -8,6 +8,12 @@ from apex_tpu.contrib.sparsity.asp import (
     m4n2_1d_mask,
     sparsity_ratio,
 )
+from apex_tpu.contrib.sparsity.permutation_search import (
+    magnitude_efficacy,
+    permuted_m4n2_mask,
+    search_for_good_permutation,
+)
 
 __all__ = ["ASP", "MaskedOptimizer", "apply_masks", "compute_sparse_masks",
-           "m4n2_1d_mask", "sparsity_ratio"]
+           "m4n2_1d_mask", "magnitude_efficacy", "permuted_m4n2_mask",
+           "search_for_good_permutation", "sparsity_ratio"]

@@ -17,8 +17,10 @@ reshape/top-2 pass per weight; XLA compiles it into a handful of
 elementwise ops (no sort).
 
 The permutation-search accuracy refinement
-(``permutation_search_kernels``) is not ported: it is an offline
-preprocessing heuristic, orthogonal to the training data flow.
+(``permutation_search_kernels``) lives in
+:mod:`apex_tpu.contrib.sparsity.permutation_search` — pass
+``allow_permutation=True`` (the reference knob) to
+:func:`compute_sparse_masks` to mask in the searched channel grouping.
 
 Note on layout: weights here are ``(in, out)`` (JAX convention; torch is
 ``(out, in)``), so groups run along axis 0 — the contraction dim, which
@@ -67,19 +69,38 @@ def _eligible(path_name: str, leaf, allowed_layer_names,
 def compute_sparse_masks(params, mask_calculator: str = "m4n2_1d",
                          allowed_layer_names=None,
                          disallowed_layer_names=("embedding", "norm",
-                                                 "bias")):
+                                                 "bias"),
+                         allow_permutation: bool = False):
     """Mask pytree: a boolean keep-mask for every eligible 2-D weight,
     ``None`` elsewhere (embeddings/norms/biases by default, mirroring the
-    reference's module-type allowlist)."""
+    reference's module-type allowlist).
+
+    ``allow_permutation`` (the reference knob of the same name): run the
+    offline channel-permutation search per weight
+    (``permutation_search.search_for_good_permutation``) and compute the
+    mask in the found grouping, mapped back to the original row order —
+    more retained magnitude, hence less pruning damage."""
     calc = _CALCULATORS[mask_calculator]
+    if allow_permutation and mask_calculator != "m4n2_1d":
+        raise ValueError(
+            f"allow_permutation=True searches for m4n2 groupings; it does "
+            f"not compose with mask_calculator={mask_calculator!r}")
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree.structure(params)
     masks = []
     for path, leaf in flat:
         name = "/".join(str(getattr(p, "key", p)) for p in path).lower()
-        masks.append(calc(leaf)
-                     if _eligible(name, leaf, allowed_layer_names,
-                                  disallowed_layer_names) else None)
+        if not _eligible(name, leaf, allowed_layer_names,
+                         disallowed_layer_names):
+            masks.append(None)
+        elif allow_permutation:
+            from apex_tpu.contrib.sparsity.permutation_search import (
+                permuted_m4n2_mask,
+            )
+
+            masks.append(permuted_m4n2_mask(leaf)[0])
+        else:
+            masks.append(calc(leaf))
     return jax.tree.unflatten(treedef, [m if m is not None else _NoMask()
                                         for m in masks])
 
@@ -152,18 +173,19 @@ class ASP:
 
     _masks = None
     _params = None
-    _config = None  # (mask_calculator, allowed, disallowed) from init
+    _config = None  # (calculator, allowed, disallowed, permutation)
 
     @classmethod
     def init_model_for_pruning(cls, params, mask_calculator="m4n2_1d",
                                allowed_layer_names=None,
                                disallowed_layer_names=("embedding", "norm",
-                                                       "bias")):
+                                                       "bias"),
+                               allow_permutation: bool = False):
         cls._config = (mask_calculator, allowed_layer_names,
-                       disallowed_layer_names)
+                       disallowed_layer_names, allow_permutation)
         cls._masks = compute_sparse_masks(
             params, mask_calculator, allowed_layer_names,
-            disallowed_layer_names)
+            disallowed_layer_names, allow_permutation=allow_permutation)
         cls._params = apply_masks(params, cls._masks)
         return cls._params, cls._masks
 
@@ -183,8 +205,9 @@ class ASP:
             raise RuntimeError("call ASP.init_model_for_pruning first")
         if params is None:
             params = cls._params
-        calc, allowed, disallowed = cls._config
-        cls._masks = compute_sparse_masks(params, calc, allowed, disallowed)
+        calc, allowed, disallowed, permute = cls._config
+        cls._masks = compute_sparse_masks(params, calc, allowed, disallowed,
+                                          allow_permutation=permute)
         cls._params = apply_masks(params, cls._masks)
         return cls._params, cls._masks
 

@@ -1,0 +1,145 @@
+"""Permutation search for 2:4 structured sparsity.
+
+Rebuild of ``apex/contrib/sparsity/permutation_search_kernels`` (SURVEY.md
+§2.5 sparsity row): before computing N:M masks, find a permutation of the
+input channels (rows here — groups run along axis 0, see asp.py) that
+maximizes the magnitude retained by the 2-of-4 mask. Random channel
+grouping loses accuracy when correlated channels land in one group of 4;
+the reference's offline search recovers most of it.
+
+Algorithm (the reference's core strategy, vectorized with numpy instead
+of CUDA kernels — this is OFFLINE preprocessing, not a training-loop op):
+repeated passes of exhaustive two-group re-splits. For every pair of
+groups-of-4, evaluate all 35 ways to split their 8 channels into two new
+groups and keep the best (the reference's ``Exhaustive_Search`` over
+stripe-group pairs); passes repeat until a fixed point or ``max_passes``.
+Each pair-evaluation is one vectorized top-2-of-4 reduction over all
+output columns.
+
+Where apex physically permutes the weights and rewires neighboring
+layers (a torch graph pass), this functional form keeps weights in place
+and returns the permutation + the mask mapped BACK to the original
+order: the resulting mask is exactly "2:4-expressible under the found
+permutation", which is the property the sparse matrix unit (or a sparse
+kernel) consumes, without graph surgery.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _retained_per_group(wabs: np.ndarray) -> np.ndarray:
+    """wabs: (G, 4, C) |w| grouped rows -> (G,) magnitude kept by 2:4."""
+    part = np.partition(wabs, 2, axis=1)[:, 2:, :]  # top-2 of each 4
+    return part.sum(axis=(1, 2))
+
+
+def magnitude_efficacy(w: np.ndarray, perm: Optional[np.ndarray] = None) -> float:
+    """Total |w| retained by the m4n2 mask under ``perm`` (identity when
+    None), normalized by total |w| — 1.0 means lossless pruning."""
+    wabs = np.abs(np.asarray(w, np.float32))
+    if perm is not None:
+        wabs = wabs[perm]
+    g = wabs.reshape(-1, 4, wabs.shape[-1])
+    return float(_retained_per_group(g).sum() / max(wabs.sum(), 1e-30))
+
+
+# the 35 ways to choose which 4 of 8 channels form the first group
+# (complement forms the second; fixing channel 0 in the first group
+# halves the C(8,4)=70 splits to the 35 distinct ones)
+_SPLITS = np.asarray(
+    [(0,) + c for c in combinations(range(1, 8), 3)], np.int64)
+_COMPL = np.asarray(
+    [[j for j in range(8) if j not in set(s)] for s in _SPLITS], np.int64)
+
+
+def search_for_good_permutation(
+    w,
+    max_passes: int = 10,
+    seed: int = 0,
+    search_time_limit: float = 60.0,
+    max_score_columns: int = 512,
+) -> np.ndarray:
+    """Find a row permutation of ``w`` (2-D, rows divisible by 4)
+    maximizing the magnitude the m4n2 mask retains. Returns the
+    permutation as an int array ``perm`` such that ``w[perm]`` is the
+    well-grouped layout. Deterministic for a given seed.
+
+    Reference: ``permutation_search_kernels.search_for_good_permutation``
+    — same exhaustive two-group strategy, numpy-vectorized, with the
+    reference's wall-clock budget (``search_time_limit`` seconds per
+    weight; the search stops at the best permutation found so far) and
+    column subsampling for the SCORING only (``max_score_columns``
+    evenly-strided columns; the final mask is computed on the full
+    weight, the sample only steers the heuristic — the reference's
+    kernels bound their work the same two ways)."""
+    import time as _time
+
+    wabs = np.abs(np.asarray(w, np.float32))
+    if wabs.ndim != 2 or wabs.shape[0] % 4:
+        raise ValueError(
+            f"permutation search needs a 2-D weight with rows divisible "
+            f"by 4, got shape {wabs.shape}")
+    R = wabs.shape[0]
+    G = R // 4
+    perm = np.arange(R)
+    if G < 2:
+        return perm
+    rng = np.random.RandomState(seed)
+    if wabs.shape[1] > max_score_columns:
+        stride = wabs.shape[1] // max_score_columns
+        wabs = wabs[:, ::stride][:, :max_score_columns]
+
+    deadline = _time.monotonic() + search_time_limit
+    cur = wabs[perm].reshape(G, 4, -1)
+    retained = _retained_per_group(cur)
+
+    for _ in range(max_passes):
+        improved = False
+        # randomized pass order decorrelates from initialization order
+        pairs = [(a, b) for a in range(G) for b in range(a + 1, G)]
+        rng.shuffle(pairs)
+        for a, b in pairs:
+            if _time.monotonic() > deadline:
+                return perm
+            eight = np.concatenate([cur[a], cur[b]], axis=0)  # (8, C)
+            # all 35 re-splits at once: (35, 4, C) each side
+            ga = eight[_SPLITS]
+            gb = eight[_COMPL]
+            score = (_retained_per_group(ga) + _retained_per_group(gb))
+            best = int(np.argmax(score))
+            if score[best] > retained[a] + retained[b] + 1e-7:
+                improved = True
+                sel_a, sel_b = _SPLITS[best], _COMPL[best]
+                # update the permutation bookkeeping
+                rows = np.concatenate(
+                    [perm[a * 4:(a + 1) * 4], perm[b * 4:(b + 1) * 4]])
+                perm[a * 4:(a + 1) * 4] = rows[sel_a]
+                perm[b * 4:(b + 1) * 4] = rows[sel_b]
+                cur[a] = eight[sel_a]
+                cur[b] = eight[sel_b]
+                ra = _retained_per_group(cur[a][None])[0]
+                rb = _retained_per_group(cur[b][None])[0]
+                retained[a], retained[b] = ra, rb
+        if not improved:
+            break
+    return perm
+
+
+def permuted_m4n2_mask(w, max_passes: int = 10, seed: int = 0):
+    """(mask, perm): the m4n2 keep-mask computed in the searched
+    permutation's grouping, mapped back to the ORIGINAL row order — the
+    mask an accuracy-preserving 2:4 pruning actually applies."""
+    import jax.numpy as jnp
+
+    from apex_tpu.contrib.sparsity.asp import m4n2_1d_mask
+
+    perm = search_for_good_permutation(w, max_passes=max_passes, seed=seed)
+    w_np = np.asarray(w)
+    mask_permuted = np.asarray(m4n2_1d_mask(jnp.asarray(w_np[perm])))
+    inv = np.argsort(perm)
+    return jnp.asarray(mask_permuted[inv]), perm

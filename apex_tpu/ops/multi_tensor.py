@@ -340,10 +340,17 @@ def multi_tensor_sgd(
 def multi_tensor_lamb_stage1(
     chunk_size, noop_flag, tensor_lists, beta1, beta2, eps, step,
     bias_correction, weight_decay, grad_averaging, global_grad_norm,
-    max_global_grad_norm,
+    max_global_grad_norm, grad_pre_scale=1.0,
 ):
     """LAMB stage 1 (``multi_tensor_lamb_stage_1``): clip by global grad
     norm, update moments, produce per-tensor update directions.
+
+    ``grad_pre_scale`` multiplies every gradient before use — folded into
+    the same elementwise chain as the clip, so unscaling loss-scaled
+    gradients here is FREE (no separate unscale pass over HBM; the
+    reference reaches the same economy by passing the combined scale
+    into its stage-1 kernel). ``global_grad_norm`` must already be the
+    UNSCALED norm when a pre-scale is used.
 
     Returns ``(update_list, new_m_list, new_v_list)`` in fp32.
     """
@@ -355,6 +362,7 @@ def multi_tensor_lamb_stage1(
         max_global_grad_norm / global_grad_norm,
         1.0,
     ) if max_global_grad_norm > 0 else jnp.float32(1.0)
+    clip = clip * grad_pre_scale
 
     if bias_correction:
         bc1 = 1.0 - beta1 ** step

@@ -66,7 +66,15 @@ class FusedLAMB(FusedOptimizer):
             master=self._master_init(params),
         )
 
-    def step(self, grads, state: LambState, params, skip_if=None, lr=None):
+    def step(self, grads, state: LambState, params, skip_if=None, lr=None,
+             grad_scale=None):
+        """One fused LAMB step. ``grad_scale``: when given, ``grads`` are
+        LOSS-SCALED by this factor and the step unscales them for free
+        inside its own reads (norm rescale + stage-1 pre-scale — no
+        separate unscale pass) AND detects overflow from the norm it
+        already computes. With ``grad_scale`` the return is
+        ``(params, state, found_inf)`` (found_inf is folded into the
+        skip); without it, ``(params, state)`` as before."""
         lr = self.lr if lr is None else lr
         step = state.step + 1
 
@@ -80,6 +88,16 @@ class FusedLAMB(FusedOptimizer):
         global_norm, _ = multi_tensor_applier(
             multi_tensor_l2norm, None, [g], False
         )
+        pre_scale = 1.0
+        found_inf = None
+        if grad_scale is not None:
+            # inf/nan anywhere in the grads surfaces in the raw norm —
+            # the amp overflow check rides this existing reduction
+            found_inf = jnp.logical_not(jnp.isfinite(global_norm))
+            pre_scale = (1.0 / jnp.asarray(grad_scale, jnp.float32))
+            global_norm = global_norm * pre_scale
+            skip_if = (found_inf if skip_if is None
+                       else jnp.logical_or(skip_if, found_inf))
 
         # Stage 1: clip + moments + update directions.
         updates, new_m, new_v = multi_tensor_applier(
@@ -95,6 +113,7 @@ class FusedLAMB(FusedOptimizer):
             self.grad_averaging,
             global_norm,
             self.max_grad_norm,
+            pre_scale,
         )
 
         # Stage 2: per-tensor trust ratios + parameter step.
@@ -118,7 +137,11 @@ class FusedLAMB(FusedOptimizer):
             exp_avg_sq=like_tree(new_v, state.exp_avg_sq),
             master=new_master,
         )
-        return self._finish_step(skip_if, new_p, new_state, params, state)
+        out_p, out_s = self._finish_step(skip_if, new_p, new_state, params,
+                                         state)
+        if found_inf is not None:
+            return out_p, out_s, found_inf
+        return out_p, out_s
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,6 +29,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Per-invocation entropy for ALL benchmark inputs. The axon runtime
+# memoizes (program, inputs) -> results ACROSS PROCESSES: a re-run of a
+# bit-identical deterministic benchmark is served from cache and reports
+# a physically impossible step time (observed: 5 ms/step for the 367M
+# fwd+bwd+LAMB step that really takes ~200 ms). Salting the data seeds
+# guarantees every invocation measures fresh execution; the reported
+# loss varies in the third decimal run-to-run, which is expected.
+_SALT = int(time.time() * 1e3) % (2 ** 30)
+
+
 def build_step(cfg_kwargs, opt_level, batch, seq):
     import apex_tpu.amp as amp
     from apex_tpu.models import BertConfig, BertForPreTraining, pretraining_loss
@@ -40,7 +50,7 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
     cfg = maker(**cfg_kwargs)
     model = BertForPreTraining(cfg)
 
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(_SALT)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     types = jnp.zeros((batch, seq), jnp.int32)
     attn = jnp.ones((batch, seq), jnp.int32)
@@ -72,8 +82,19 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
                                        rngs={"dropout": sub})
                 return pretraining_loss(mlm, nsp, mlm_labels, nsp_labels)
 
-            (loss, found), grads = handle.value_and_grad(loss_fn, sst)(params)
-            p2, ost2 = opt.step(grads, ost, params, skip_if=found)
+            if opt_level == "O2":
+                # fused tail: scaled grads go straight into LAMB, which
+                # unscales inside its own reads and overflow-checks via
+                # its global-norm reduction (one fewer full pass over
+                # the gradient tree than unscale-then-step)
+                loss, grads = handle.scaled_value_and_grad(loss_fn, sst)(
+                    params)
+                p2, ost2, found = opt.step(grads, ost, params,
+                                           grad_scale=sst.loss_scale)
+            else:
+                (loss, found), grads = handle.value_and_grad(loss_fn, sst)(
+                    params)
+                p2, ost2 = opt.step(grads, ost, params, skip_if=found)
             return p2, ost2, handle.scalers[0].update(sst, found), loss, key
 
     # NOTE: no donate_argnums — buffer donation triggers a runtime
@@ -91,7 +112,7 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
     # it: without buffer donation (unsupported on axon), any lingering
     # caller reference to the initial 5 GB state tuple keeps it alive for
     # the whole timing loop and OOMs the 16 GB chip at step 1.
-    return jitted, [(params, ost, sst, jax.random.PRNGKey(17))], model_info
+    return jitted, [(params, ost, sst, jax.random.PRNGKey(_SALT))], model_info
 
 
 def time_steps(jitted, state_box, warmup=2, iters=8):
@@ -194,7 +215,7 @@ def bench_layer_norm():
     stock-XLA LN, fwd+bwd at the BERT-large shape. Value = speedup (x)."""
     from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 
-    x0 = jax.random.normal(jax.random.PRNGKey(0), (16 * 512, 1024),
+    x0 = jax.random.normal(jax.random.PRNGKey(_SALT), (16 * 512, 1024),
                            jnp.bfloat16)
     w = jnp.ones((1024,), jnp.float32)
     b = jnp.zeros((1024,), jnp.float32)
@@ -234,7 +255,7 @@ def bench_fused_lamb():
     (~25.6M params, 161 leaves). Value = speedup (x)."""
     from apex_tpu.optimizers import FusedLAMB
 
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(_SALT)
     leaves = {}
     # ResNet-50-ish spectrum: many small conv/bn leaves + a few big ones
     for i in range(53):
@@ -425,10 +446,14 @@ def main():
     # (previously prose in docs/kernels.md only)
     _reset()
     for bench_fn in (bench_layer_norm, bench_fused_lamb, bench_ddp_scaling):
-        try:
-            print(json.dumps(bench_fn()))
-        except Exception as e:  # a secondary metric must not kill the run
-            print(f"# {bench_fn.__name__} failed: {e}", file=sys.stderr)
+        for attempt in (0, 1):  # one retry: the remote-compile tunnel
+            try:                # occasionally drops a response mid-read
+                print(json.dumps(bench_fn()))
+                break
+            except Exception as e:  # secondary metric must not kill the run
+                print(f"# {bench_fn.__name__} attempt {attempt} failed: {e}",
+                      file=sys.stderr)
+                _reset()
         _reset()
 
 

@@ -661,3 +661,59 @@ def test_transducer_pack_unpack_roundtrip():
         np.testing.assert_array_equal(np.asarray(back)[b, :fl, :w],
                                       np.asarray(dense)[b, :fl, :w])
     assert float(jnp.abs(back[1, 2:, :]).max()) == 0.0
+
+
+# -------------------------------------------------- permutation search
+
+def test_permutation_search_improves_retained_magnitude():
+    """A weight built so identity grouping is pessimal (each group of 4
+    holds one large 'family'): the search must regroup and retain
+    strictly more magnitude; with permutation the mask stays exactly
+    2:4 in the searched grouping."""
+    from apex_tpu.contrib.sparsity import (
+        compute_sparse_masks,
+        magnitude_efficacy,
+        m4n2_1d_mask,
+        search_for_good_permutation,
+    )
+
+    rng = np.random.RandomState(0)
+    R, C = 32, 16
+    # adversarial: rows 4k..4k+3 all large in the same columns, so
+    # identity groups must drop half the large values; interleaving
+    # groups keeps all of them
+    w = np.full((R, C), 0.01, np.float32)
+    for g in range(R // 4):
+        w[4 * g:4 * g + 4, :] += rng.rand(1, C) * (1 + g)
+    w = jnp.asarray(w * (1 + 0.001 * rng.rand(R, C)))
+
+    base = magnitude_efficacy(np.asarray(w))
+    perm = search_for_good_permutation(w)
+    tuned = magnitude_efficacy(np.asarray(w), perm)
+    assert tuned > base + 0.01, (base, tuned)
+    assert sorted(perm.tolist()) == list(range(R))
+
+    masks = compute_sparse_masks({"linear": w}, allow_permutation=True)
+    mask = masks["linear"]
+    # exactly 50% kept, and 2-of-4 in the PERMUTED grouping
+    assert float(jnp.mean(mask.astype(jnp.float32))) == 0.5
+    grouped = np.asarray(mask)[perm].reshape(-1, 4, C).sum(axis=1)
+    np.testing.assert_array_equal(grouped, np.full_like(grouped, 2))
+    # retained magnitude via the permuted mask > identity-grouping mask
+    ident = np.abs(np.asarray(w))[np.asarray(m4n2_1d_mask(w))].sum()
+    permed = np.abs(np.asarray(w))[np.asarray(mask)].sum()
+    assert permed > ident
+
+
+def test_permutation_search_deterministic_and_identity_safe():
+    from apex_tpu.contrib.sparsity import search_for_good_permutation
+
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(16, 8).astype("f4"))
+    p1 = search_for_good_permutation(w)
+    p2 = search_for_good_permutation(w)
+    np.testing.assert_array_equal(p1, p2)
+    # a single group: nothing to search
+    small = jnp.asarray(rng.randn(4, 8).astype("f4"))
+    np.testing.assert_array_equal(search_for_good_permutation(small),
+                                  np.arange(4))

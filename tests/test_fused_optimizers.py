@@ -280,3 +280,59 @@ def test_optimizer_step_inside_jit_with_amp():
     assert float(sst.loss_scale) == 2.0 ** 15
     p, st, sst, _ = step(p, st, sst, jnp.asarray(1.0))
     assert int(st.step) == 2
+
+
+def test_lamb_grad_scale_matches_unscale_then_step():
+    """step(grad_scale=S) on S-scaled grads == unscale-then-step (the
+    fused amp tail): trajectories identical, overflow detected from the
+    norm."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(33, 17).astype("f4")),
+              "b": jnp.asarray(rng.randn(17).astype("f4"))}
+    grads = {"w": jnp.asarray(rng.randn(33, 17).astype("f4") * 0.1),
+             "b": jnp.asarray(rng.randn(17).astype("f4") * 0.1)}
+    scale = 2.0 ** 12
+    scaled = jax.tree.map(lambda g: g * scale, grads)
+    opt = FusedLAMB(lr=1e-2, weight_decay=0.01)
+
+    p_ref, s_ref = params, opt.init(params)
+    p_fus, s_fus = params, opt.init(params)
+    for _ in range(3):
+        p_ref, s_ref = opt.step(grads, s_ref, p_ref)
+        p_fus, s_fus, found = opt.step(scaled, s_fus, p_fus,
+                                       grad_scale=scale)
+        assert not bool(found)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_fus)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # overflow: inf in scaled grads -> found, step skipped entirely
+    bad = jax.tree.map(lambda g: g.at[0].set(jnp.inf)
+                       if g.ndim == 1 else g, scaled)
+    p3, s3, found = opt.step(bad, s_fus, p_fus, grad_scale=scale)
+    assert bool(found)
+    for a, b in zip(jax.tree.leaves(p3), jax.tree.leaves(p_fus)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(s3.step) == int(s_fus.step)
+
+
+def test_scaled_value_and_grad_defers_unscale():
+    """handle/scaler scaled_value_and_grad returns SCALED grads equal to
+    scale * value_and_grad's unscaled grads, and the same loss."""
+    from apex_tpu.amp import LossScaler
+
+    scaler = LossScaler()
+    st = scaler.init()
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype("f4"))
+
+    def loss_fn(w):
+        return jnp.mean(w ** 2)
+
+    (loss_a, found), g_unscaled = scaler.value_and_grad(loss_fn, st)(w)
+    loss_b, g_scaled = scaler.scaled_value_and_grad(loss_fn, st)(w)
+    assert float(loss_a) == float(loss_b)
+    np.testing.assert_allclose(
+        np.asarray(g_scaled),
+        np.asarray(g_unscaled) * float(st.loss_scale), rtol=1e-6)
