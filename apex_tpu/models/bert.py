@@ -116,51 +116,10 @@ def _attn_softmax(cfg, scores, mask):
     return jax.nn.softmax(xf, axis=-1).astype(scores.dtype)
 
 
-def _dropout_seed(module, tp_fold: bool):
-    """int32 seed for the fused in-kernel dropout, derived from the flax
-    "dropout" stream; ``tp_fold`` mixes in the TP rank so head-sharded
-    regions decorrelate across ranks (CudaRNGStatesTracker semantics)
-    while replicated regions share one mask."""
-    key = module.make_rng("dropout")
-    if tp_fold:
-        from apex_tpu.transformer.tensor_parallel.random import (
-            model_parallel_key,
-        )
-
-        key = model_parallel_key(key)
-    return jax.random.randint(key, (), 0, 2 ** 31 - 1, dtype=jnp.int32)
-
-
-class _TPDropout(nn.Module):
-    """Dropout whose key folds in the TP rank when the activation is
-    sharded over the tensor axis (reference: CudaRNGStatesTracker — TP
-    regions draw from the per-rank model-parallel stream so masks
-    decorrelate; replicated regions keep the shared stream so all ranks
-    apply the identical mask)."""
-
-    rate: float
-    tp_varying: bool = False
-    # Pallas hardware-PRNG dropout (ops/dropout.py): measured ~42 ms ->
-    # ~4 ms per BERT-large step vs the threefry masks of nn.Dropout
-    fused: bool = True
-
-    @nn.compact
-    def __call__(self, x, deterministic: bool = True):
-        if deterministic or self.rate == 0.0:
-            return x
-        if self.fused:
-            from apex_tpu.ops.dropout import fused_dropout
-
-            return fused_dropout(x, self.rate,
-                                 _dropout_seed(self, self.tp_varying))
-        key = self.make_rng("dropout")
-        if self.tp_varying:
-            from apex_tpu.transformer.tensor_parallel.random import (
-                model_parallel_key,
-            )
-
-            key = model_parallel_key(key)
-        return nn.Dropout(self.rate)(x, deterministic=False, rng=key)
+from apex_tpu.models._dropout import (  # noqa: E402 (model-shared)
+    TPDropout as _TPDropout,
+    dropout_seed as _dropout_seed,
+)
 
 
 # sequence-parallel layout helpers: (B, S_local, H) <-> (S_local*B, H)

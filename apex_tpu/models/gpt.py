@@ -26,6 +26,11 @@ from __future__ import annotations
 import dataclasses
 
 import flax.linen as nn
+
+from apex_tpu.models._dropout import (
+    TPDropout as _TPDropout,
+    dropout_seed as _dropout_seed,
+)
 import jax
 import jax.numpy as jnp
 
@@ -85,8 +90,11 @@ def _norm(cfg, name):
                         param_dtype=jnp.float32, name=name)
 
 
-def _causal_attend(cfg, q, k, v, scale):
-    """(B, nh, S, hd) causal attention via the selected backend."""
+def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
+    """(B, nh, S, hd) causal attention via the selected backend.
+    ``dropout_rate``/``seed``: fused in-kernel attention-probability
+    dropout (flash + composed paths; the blockwise ring/Ulysses
+    backends apply no prob dropout — see flash_attention_with_lse)."""
     if cfg.attention_backend == "ring":
         from apex_tpu.ops.ring_attention import ring_attention
 
@@ -100,11 +108,13 @@ def _causal_attend(cfg, q, k, v, scale):
     if cfg.fused_kernels:
         from apex_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, None, True, scale)
+        return flash_attention(q, k, v, None, True, scale,
+                               dropout_rate, seed)
     # composed fallback: the shared parity reference
     from apex_tpu.ops.flash_attention import mha_reference
 
-    return mha_reference(q, k, v, None, True, scale)
+    return mha_reference(q, k, v, None, True, scale, dropout_rate, seed)
+
 
 
 class GPTBlock(nn.Module):
@@ -126,11 +136,23 @@ class GPTBlock(nn.Module):
         def heads(t):
             return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
 
+        blockwise = cfg.attention_backend in ("ring", "ulysses")
+        attn_drop = 0.0 if (deterministic or blockwise) else cfg.dropout
+        if blockwise and cfg.dropout > 0.0 and not deterministic:
+            import warnings
+
+            warnings.warn(
+                f"GPT attention_backend={cfg.attention_backend!r} applies "
+                "NO attention-probability dropout (blockwise lse merging "
+                "would double-count it); hidden/embedding dropout still "
+                "applies. Set dropout=0.0 to silence.", stacklevel=2)
+        seed = (_dropout_seed(self, False) if attn_drop > 0.0 else None)
         ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
-                             1.0 / (hd ** 0.5))
+                             1.0 / (hd ** 0.5), attn_drop, seed)
         ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, S, h)
         attn = _dense(cfg, h, "attn_out")(ctx)
-        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        attn = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+            attn, deterministic=deterministic)
         x = x + attn
 
         # pre-LN MLP (dense or mixture-of-experts)
@@ -149,7 +171,8 @@ class GPTBlock(nn.Module):
         else:
             y = nn.gelu(_dense(cfg, 4 * h, "mlp_in")(y))
             y = _dense(cfg, h, "mlp_out")(y)
-        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        y = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+            y, deterministic=deterministic)
         return x + y
 
 
@@ -195,7 +218,8 @@ class GPTModel(nn.Module):
         pos = jax.lax.dynamic_slice_in_dim(
             wpe, position_offset, S_local, axis=0)
         x = (wte[input_ids] + pos[None]).astype(cfg.dtype)
-        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        x = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+            x, deterministic=deterministic)
 
         block_cls = GPTBlock
         if cfg.remat:
