@@ -14,10 +14,7 @@ import jax
 import jax.numpy as jnp
 
 
-def dropout_seed(module: nn.Module, tp_fold: bool):
-    """int32 seed for the fused in-kernel dropout, derived from the flax
-    "dropout" stream; ``tp_fold`` mixes in the TP rank so head-sharded
-    regions decorrelate across ranks."""
+def _folded_key(module: nn.Module, tp_fold: bool, fold_axes=()):
     key = module.make_rng("dropout")
     if tp_fold:
         from apex_tpu.transformer.tensor_parallel.random import (
@@ -25,15 +22,30 @@ def dropout_seed(module: nn.Module, tp_fold: bool):
         )
 
         key = model_parallel_key(key)
+    for ax in fold_axes:
+        key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+    return key
+
+
+def dropout_seed(module: nn.Module, tp_fold: bool, fold_axes=()):
+    """int32 seed for the fused in-kernel dropout, derived from the flax
+    "dropout" stream; ``tp_fold`` mixes in the TP rank so head-sharded
+    regions decorrelate across ranks, and ``fold_axes`` mixes in further
+    mesh-axis ranks (e.g. the context axis under sequence-sharded
+    ring/Ulysses training, where each rank's activation shard must get
+    its own masks)."""
+    key = _folded_key(module, tp_fold, fold_axes)
     return jax.random.randint(key, (), 0, 2 ** 31 - 1, dtype=jnp.int32)
 
 
 class TPDropout(nn.Module):
-    """Dropout whose key folds in the TP rank when the activation is
-    sharded over the tensor axis (see :func:`dropout_seed`)."""
+    """Dropout whose key folds in the TP rank (``tp_varying``) and/or
+    further mesh-axis ranks (``fold_axes``) when the activation is
+    sharded over those axes (see :func:`dropout_seed`)."""
 
     rate: float
     tp_varying: bool = False
+    fold_axes: tuple = ()
     # Pallas hardware-PRNG dropout (ops/dropout.py): measured ~42 ms ->
     # ~4 ms per BERT-large step vs the threefry masks of nn.Dropout
     fused: bool = True
@@ -46,12 +58,7 @@ class TPDropout(nn.Module):
             from apex_tpu.ops.dropout import fused_dropout
 
             return fused_dropout(x, self.rate,
-                                 dropout_seed(self, self.tp_varying))
-        key = self.make_rng("dropout")
-        if self.tp_varying:
-            from apex_tpu.transformer.tensor_parallel.random import (
-                model_parallel_key,
-            )
-
-            key = model_parallel_key(key)
+                                 dropout_seed(self, self.tp_varying,
+                                              self.fold_axes))
+        key = _folded_key(self, self.tp_varying, self.fold_axes)
         return nn.Dropout(self.rate)(x, deterministic=False, rng=key)

