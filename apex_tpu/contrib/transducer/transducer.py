@@ -54,7 +54,12 @@ def transducer_batch_offset(f_len, y_len):
 def _packed_coords(packed_size, batch_offset, y_len):
     """Map packed position p -> (b, t, u). Positions past the true total
     yield garbage coords — callers mask them with their own validity
-    test (see transducer_pack)."""
+    test (see transducer_pack).
+
+    Zero-size examples (f_len[b] == 0) are safe: they produce duplicate
+    offsets, and ``side="right"`` resolves a position at a duplicate run
+    to the LAST index with offset <= p — the non-empty successor, never
+    the empty example (regression-tested in test_transducer.py)."""
     p = jnp.arange(packed_size, dtype=jnp.int32)
     # b = index of the last offset <= p
     b = (jnp.searchsorted(batch_offset, p, side="right") - 1).astype(jnp.int32)

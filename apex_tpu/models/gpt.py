@@ -101,16 +101,18 @@ def _ctx_fold_axes(cfg):
 def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
     """(B, nh, S, hd) causal attention via the selected backend.
     ``dropout_rate``/``seed``: fused in-kernel attention-probability
-    dropout. Supported by flash, composed, AND Ulysses (which runs
-    plain flash attention over the full sequence after head
-    re-sharding); only the ring backend drops it — its blockwise lse
-    merging would double-count a per-block dropout (the model warns
-    once at trace time, see GPTModel)."""
+    dropout, supported by EVERY backend — flash, composed, Ulysses
+    (full-sequence flash after head re-sharding), and ring (per-block
+    fused dropout keyed on global block-pair ids; the lse merge keeps
+    statistics pre-dropout so nothing double-counts — see
+    ops/ring_attention.py). All backends train at the true config."""
     if cfg.attention_backend == "ring":
         from apex_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, None, True, scale,
-                              axis_name=cfg.context_axis)
+                              axis_name=cfg.context_axis,
+                              dropout_rate=dropout_rate,
+                              dropout_seed=seed)
     if cfg.attention_backend == "ulysses":
         from apex_tpu.ops.ulysses_attention import ulysses_attention
 
@@ -149,12 +151,11 @@ class GPTBlock(nn.Module):
         def heads(t):
             return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
 
-        attn_drop = (0.0 if (deterministic
-                             or cfg.attention_backend == "ring")
-                     else cfg.dropout)
+        attn_drop = 0.0 if deterministic else cfg.dropout
         # Ulysses ranks share local head indices for different global
-        # heads; the context rank is folded into the seed inside
-        # ulysses_attention itself
+        # heads (rank folded into the seed inside ulysses_attention);
+        # ring ranks share the base seed and decorrelate via the global
+        # block-pair hash inside ring_attention
         seed = (_dropout_seed(self, False) if attn_drop > 0.0 else None)
         ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
                              1.0 / (hd ** 0.5), attn_drop, seed)
@@ -206,18 +207,6 @@ class GPTModel(nn.Module):
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          jnp.float32)
         if cfg.attention_backend in ("ring", "ulysses"):
-            if (cfg.attention_backend == "ring" and cfg.dropout > 0.0
-                    and not deterministic):
-                import warnings
-
-                # once per trace, at the model level (not per block)
-                warnings.warn(
-                    "GPT attention_backend='ring' applies NO attention-"
-                    "probability dropout (its blockwise lse merging would "
-                    "double-count a per-block dropout; use 'ulysses' if "
-                    "attention dropout matters); hidden/embedding dropout "
-                    "still applies. Set dropout=0.0 to silence.",
-                    stacklevel=2)
             # sequence-sharded: this shard's global positions. Validate
             # the table covers the GLOBAL sequence — dynamic_slice would
             # silently clamp and duplicate positions otherwise.

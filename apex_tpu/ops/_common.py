@@ -30,6 +30,21 @@ def keep_threshold(dropout_rate):
     return jnp.uint32(min(int(keep * 4294967296.0), 4294967295))
 
 
+def mix_seed(seed, n):
+    """Decorrelated int32 PRNG seed from (seed, n): golden-ratio
+    multiplicative hash in uint32 wraparound arithmetic, masked to
+    non-negative int32. Shared by every consumer that derives per-rank /
+    per-block dropout seeds (ring block pairs, Ulysses context ranks) so
+    the derivation can't drift between them; sequential `seed + n` would
+    give adjacent consumers correlated hardware-PRNG streams, and the
+    uint32 round-trip avoids int32 overflow near 2^31."""
+    import jax.numpy as jnp
+
+    mixed = (jnp.asarray(seed, jnp.int32).astype(jnp.uint32)
+             ^ (jnp.asarray(n).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
+    return (mixed & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+
+
 def use_jnp_fallback(*arrays) -> bool:
     """True when the Pallas interpreter cannot be used: non-TPU backend AND
     inputs varying over shard_map axes (this JAX version's HLO interpreter

@@ -827,55 +827,81 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # (out, lse) variant for blockwise consumers (ring attention)
 # ---------------------------------------------------------------------------
 
-def _with_lse_reference(q, k, v, key_mask, causal, scale):
-    """Composed (out, lse): the differentiable fallback path."""
+def _with_lse_reference(q, k, v, key_mask, causal, scale,
+                        dropout_rate=0.0, dropout_seed=None):
+    """Composed (out, lse): the differentiable fallback path. With
+    dropout it reproduces the kernel semantics exactly — the keep-mask
+    comes from :func:`flash_dropout_keep_mask` (bit-identical bits to
+    the in-kernel generation for this backend), applied to the
+    NORMALIZED probabilities while lse stays pre-dropout."""
     s = _scores(q, k, key_mask, causal, scale)
     lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
     p = jnp.exp(s - lse.transpose(0, 1, 3, 2))
+    if dropout_rate > 0.0:
+        B, H, Sq, _ = q.shape
+        keep = flash_dropout_keep_mask(B, H, Sq, k.shape[2], dropout_rate,
+                                       dropout_seed)
+        p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
     out = jnp.einsum("bhqk,bhkd->bhqd", p,
                      v.astype(jnp.float32)).astype(q.dtype)
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention_with_lse(q, k, v, key_mask=None, causal: bool = False,
-                             scale: float = 1.0):
+                             scale: float = 1.0, dropout_rate: float = 0.0,
+                             dropout_seed=None):
     """Flash attention returning ``(out, lse)`` with lse trimmed to the
     true Sq — the building block for blockwise/ring consumers that merge
     per-block results via log-sum-exp. Differentiable INCLUDING the lse
     output: its cotangent folds into the recompute backward's delta
     (``delta = rowsum(dO*O) - dlse``; d lse/d s = p).
 
-    No dropout here: blockwise lse-merging consumers rescale partial
-    outputs by post-hoc normalizers, which would double-count a dropout
-    already applied per block — ring/Ulysses apply their own dropout at
-    the merged level instead."""
+    Dropout composes with the lse merge: the kernels apply the keep-mask
+    only where the probability tile feeds ``p @ v`` while every
+    statistic (m, l, lse) stays PRE-dropout, so a blockwise consumer
+    that rescales partial outputs by ``exp(lse_i - lse_total)`` gets
+    exactly ``sum_j drop(p_hat_j) v_j`` — composed dropout(softmax) @ v
+    over the merged distribution, nothing double-counted. Blockwise
+    callers must pass a DISTINCT seed per (global q-block, global
+    kv-block) pair (see ring_attention's hashed tile seeds) so tiles
+    draw independent streams and backward replays the same mask."""
     if use_jnp_fallback(q, k, v, key_mask):
-        return _with_lse_reference(q, k, v, key_mask, causal, scale)
-    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
+        return _with_lse_reference(q, k, v, key_mask, causal, scale,
+                                   dropout_rate, dropout_seed)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale, dropout_rate,
+                          dropout_seed)
     return out, lse[..., :q.shape[2]]
 
 
-def _fwl_fwd(q, k, v, key_mask, causal, scale):
+def _fwl_fwd(q, k, v, key_mask, causal, scale, dropout_rate, dropout_seed):
     if use_jnp_fallback(q, k, v, key_mask):
-        out, lse_t = _with_lse_reference(q, k, v, key_mask, causal, scale)
-        return (out, lse_t), (q, k, v, key_mask, out, None)
-    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale)
-    return (out, lse[..., :q.shape[2]]), (q, k, v, key_mask, out, lse)
+        out, lse_t = _with_lse_reference(q, k, v, key_mask, causal, scale,
+                                         dropout_rate, dropout_seed)
+        return (out, lse_t), (q, k, v, key_mask, out, None, dropout_seed)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal, scale, dropout_rate,
+                          dropout_seed)
+    return ((out, lse[..., :q.shape[2]]),
+            (q, k, v, key_mask, out, lse, dropout_seed))
 
 
-def _fwl_bwd(causal, scale, res, cotangents):
-    q, k, v, key_mask, out, lse_padded = res
+def _fwl_bwd(causal, scale, dropout_rate, res, cotangents):
+    q, k, v, key_mask, out, lse_padded, dropout_seed = res
     g, g_lse = cotangents
     if lse_padded is None:  # fallback path: autodiff the composed form
         def f(q, k, v):
-            return _with_lse_reference(q, k, v, key_mask, causal, scale)
+            return _with_lse_reference(q, k, v, key_mask, causal, scale,
+                                       dropout_rate, dropout_seed)
 
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp((g, g_lse))
-        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v), None)
-    return _kernel_bwd(causal, scale, q, k, v, key_mask, out, lse_padded,
-                       g, g_lse)
+        return (match_vma(dq, q), match_vma(dk, k), match_vma(dv, v),
+                None, None)
+    dq, dk, dv, dmask = _kernel_bwd(causal, scale, q, k, v, key_mask, out,
+                                    lse_padded, g, g_lse,
+                                    dropout_rate=dropout_rate,
+                                    dropout_seed=dropout_seed)
+    return dq, dk, dv, dmask, None
 
 
 flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)

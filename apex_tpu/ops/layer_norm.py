@@ -291,6 +291,13 @@ def fused_layer_norm_affine(x, weight, bias, eps: float = 1e-5,
     shapes (a separate kernel is an HBM fusion barrier). Under autodiff,
     custom_vjp dispatches to ``_ln_affine_fwd`` instead — the Pallas
     fwd+bwd pair, the measured-best training combination.
+
+    Numerical parity note: the two bodies agree to float rounding but are
+    NOT bitwise identical (jnp two-pass moments vs the kernel's Welford
+    accumulation in a different summation order), so the same call can
+    yield bitwise-different outputs depending on differentiation context.
+    Train-vs-eval logit-matching tests must compare with a dtype-scaled
+    tolerance, not exact equality.
     """
     return layer_norm_reference(x, weight, bias, eps)
 
@@ -322,7 +329,8 @@ def fused_rms_norm_affine(x, weight, eps: float = 1e-5,
     Reference surface: ``FusedRMSNormAffineFunction`` /
     ``FusedRMSNormAffineMixedDtypesFunction``. Same mode-dependent
     kernel selection as :func:`fused_layer_norm_affine`: jnp (XLA-fused)
-    when not differentiating, Pallas fwd+bwd under autodiff."""
+    when not differentiating, Pallas fwd+bwd under autodiff — and the
+    same parity caveat: the two bodies agree to rounding, not bitwise."""
     return rms_norm_reference(x, weight, eps)
 
 

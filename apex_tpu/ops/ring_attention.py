@@ -40,16 +40,32 @@ from apex_tpu.ops.flash_attention import (
 )
 
 
-def _block_attend(q, k, v, key_mask, causal, scale):
+def _block_attend(q, k, v, key_mask, causal, scale,
+                  dropout_rate=0.0, dropout_seed=None):
     """(out, lse) for one q-block vs one kv-block; lse is (B, H, 1, Sq)
     fp32. Differentiable on both paths — the flash kernel variant folds
     the lse cotangent into its recompute backward."""
-    out, lse = flash_attention_with_lse(q, k, v, key_mask, causal, scale)
+    out, lse = flash_attention_with_lse(q, k, v, key_mask, causal, scale,
+                                        dropout_rate, dropout_seed)
     return out.astype(jnp.float32), lse
 
 
+def _block_seed(seed, q_block, kv_block, cp):
+    """Per-(global q-block, global kv-block) dropout seed: the base seed
+    hashed with the block-pair id (shared :func:`mix_seed` derivation).
+    Every tile of the global attention matrix draws an independent PRNG
+    stream, and backward replays the same mask because
+    (q_block, kv_block) is recomputed identically on the reverse ring
+    pass."""
+    from apex_tpu.ops._common import mix_seed
+
+    return mix_seed(seed, q_block.astype(jnp.uint32) * jnp.uint32(cp)
+                    + kv_block.astype(jnp.uint32))
+
+
 def ring_attention(q, k, v, key_mask=None, causal: bool = False,
-                   scale: float = 1.0, axis_name: str = "context"):
+                   scale: float = 1.0, axis_name: str = "context",
+                   dropout_rate: float = 0.0, dropout_seed=None):
     """Context-parallel attention over the ring.
 
     Args:
@@ -60,6 +76,18 @@ def ring_attention(q, k, v, key_mask=None, causal: bool = False,
         sharding: device i owns tokens [i*S_local, (i+1)*S_local)).
       scale: softmax temperature.
       axis_name: the context-parallel mesh axis.
+      dropout_rate: attention-probability dropout, fused into the
+        per-block flash kernels. Correctness across the lse merge: each
+        block's kernel applies its keep-mask only to the ``p @ v``
+        accumulation while (m, l, lse) stay pre-dropout, so the merged
+        ``sum_i exp(lse_i - lse_total) * out_i`` equals composed
+        dropout(softmax(s_global)) @ v exactly (the flash linearity
+        argument extends across blocks — nothing is double-counted).
+      dropout_seed: int32 scalar; per-block masks derive from it hashed
+        with the GLOBAL (q-block=this rank, kv-block=source rank) pair
+        id, so every tile of the global attention matrix gets an
+        independent stream and the reverse ring pass replays the same
+        masks. May be shared across ranks (the tile hash decorrelates).
 
     Returns:
       (B, H, S_local, D) attention outputs for this device's queries,
@@ -85,15 +113,24 @@ def ring_attention(q, k, v, key_mask=None, causal: bool = False,
     # all-False) mask
     key_mask = mark_varying(key_mask, mark)
 
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "ring_attention with dropout_rate > 0 requires dropout_seed")
+
     def step_body(q, kv_rank, k_blk, v_blk, mask_blk):
+        seed = (None if dropout_rate == 0.0
+                else _block_seed(dropout_seed, my_rank, kv_rank, cp))
         if not causal:
-            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale)
+            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale,
+                                 dropout_rate, seed)
 
         def full(_):
-            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale)
+            return _block_attend(q, k_blk, v_blk, mask_blk, False, scale,
+                                 dropout_rate, seed)
 
         def diag(_):
-            return _block_attend(q, k_blk, v_blk, mask_blk, True, scale)
+            return _block_attend(q, k_blk, v_blk, mask_blk, True, scale,
+                                 dropout_rate, seed)
 
         def skip(_):
             return (mark_varying(

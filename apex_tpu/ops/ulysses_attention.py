@@ -41,12 +41,13 @@ def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
       axis_name: the context-parallel mesh axis; H must be divisible by
         its size.
       dropout_rate/dropout_seed: fused attention-probability dropout.
-        Unlike the ring (blockwise lse merging, where per-block dropout
-        would be double-counted), each Ulysses rank runs plain flash
-        attention over the FULL sequence for its head subset, so the
-        in-kernel dropout applies directly; the context rank is folded
-        into the seed here so different ranks' (global) heads get
-        decorrelated masks despite sharing local head indices.
+        Each Ulysses rank runs plain flash attention over the FULL
+        sequence for its head subset, so the in-kernel dropout applies
+        directly; the context rank is folded into the seed here so
+        different ranks' (global) heads get decorrelated masks despite
+        sharing local head indices. (Ring attention also supports fused
+        dropout, via global block-pair seed hashing — see
+        ring_attention.)
 
     Returns:
       (B, H, S_local, D) outputs for this device's sequence shard.
@@ -78,8 +79,13 @@ def ulysses_attention(q, k, v, key_mask=None, causal: bool = False,
             raise ValueError(
                 "ulysses_attention with dropout_rate > 0 requires "
                 "dropout_seed")
-        dropout_seed = (jnp.asarray(dropout_seed, jnp.int32)
-                        + jax.lax.axis_index(axis_name))
+        # hashed rank fold (shared mix_seed derivation): adjacent ranks
+        # get decorrelated PRNG streams, not the sequential seeds a
+        # plain `seed + rank` would produce
+        from apex_tpu.ops._common import mix_seed
+
+        dropout_seed = mix_seed(dropout_seed,
+                                jax.lax.axis_index(axis_name))
     out = flash_attention(qh, kh, vh, full_mask, causal, scale,
                           dropout_rate, dropout_seed)
     # (B, H/cp, S, D) -> (B, H, S/cp, D)

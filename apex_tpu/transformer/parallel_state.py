@@ -43,14 +43,16 @@ DATA_AXIS = "data"
 EXPERT_AXIS = "expert"
 
 
-def _slice_of(device, world, num_slices):
+def _slice_of(device, position, world, num_slices):
     """Slice id of a device: the hardware's ``slice_index`` when the
     runtime exposes one (real multi-slice TPU), else contiguous
-    device-order partitioning (virtual/CPU simulation)."""
+    partitioning by POSITION in the supplied device list — not by
+    ``device.id``, which need not be dense 0..world-1 when the caller
+    passes an arbitrary subset (e.g. a tail slice of ``jax.devices()``)."""
     idx = getattr(device, "slice_index", None)
     if idx is not None:
         return int(idx)
-    return device.id * num_slices // world
+    return position * num_slices // world
 
 
 def initialize_model_parallel(
@@ -135,8 +137,8 @@ def initialize_model_parallel(
                 f"per-slice device count ({per_slice}) != ici_pp * ici_dp "
                 f"* ep * tp ({ici_pp}*{ici_dp}*{ep}*{tp})")
         groups = [[] for _ in range(n_slices)]
-        for d in devices:
-            groups[_slice_of(d, world, n_slices)].append(d)
+        for pos, d in enumerate(devices):
+            groups[_slice_of(d, pos, world, n_slices)].append(d)
         if any(len(g) != per_slice for g in groups):
             raise RuntimeError(
                 f"uneven slices: {[len(g) for g in groups]} (expected "

@@ -97,14 +97,15 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
                 p2, ost2 = opt.step(grads, ost, params, skip_if=found)
             return p2, ost2, handle.scalers[0].update(sst, found), loss, key
 
-    # NOTE: no donate_argnums — buffer donation triggers a runtime
-    # INVALID_ARGUMENT on the axon PJRT backend (re-verified this round:
-    # a trivial donated jit works, but donating ANY of this step's args —
-    # even the 3-scalar scaler state alone — fails at run time, so it is
-    # a runtime limitation, not an aliasing bug here). Donation would
-    # halve optimizer-state peak memory (it is what caps S=512 at B=8);
-    # revisit when the runtime supports it.
-    jitted = jax.jit(step)
+    # Buffer donation: STILL unsupported on the axon runtime for real
+    # steps. Re-probed 2026-07-31 (round 4): a trivial donated jit now
+    # works (it failed in round 3), but donating this step's
+    # params/ost/sst at any B in {16, 24, 32} still dies at run time
+    # with "INVALID_ARGUMENT: TPU backend error (InvalidArgument)".
+    # Donation would halve optimizer-state peak (the B=16 cap); re-probe
+    # each round with ``--donate``.
+    donate = (0, 1, 2) if "--donate" in sys.argv else ()
+    jitted = jax.jit(step, donate_argnums=donate)
     model_info = dict(
         n_params=sum(x.size for x in jax.tree.leaves(params)),
         n_layers=cfg.num_layers, hidden=cfg.hidden_size)

@@ -663,6 +663,41 @@ def test_transducer_pack_unpack_roundtrip():
     assert float(jnp.abs(back[1, 2:, :]).max()) == 0.0
 
 
+def test_transducer_pack_zero_size_examples():
+    """Zero-size examples (f_len == 0) create duplicate batch offsets;
+    the searchsorted coordinate map must resolve positions at the
+    duplicate run to the non-empty successor, not the empty example
+    (round-3 advisor finding — verified safe, locked in here)."""
+    from apex_tpu.contrib.transducer import (
+        transducer_batch_offset,
+        transducer_pack,
+        transducer_unpack,
+    )
+
+    rng = np.random.RandomState(2)
+    B, T, U1, H = 4, 3, 3, 2
+    dense = jnp.asarray(rng.randn(B, T, U1, H).astype("float32"))
+    # examples 1 and 3 are empty (f_len 0); 3 is also terminal
+    f_len = jnp.asarray([3, 0, 2, 0], jnp.int32)
+    y_len = jnp.asarray([2, 1, 0, 2], jnp.int32)
+    offs = transducer_batch_offset(f_len, y_len)
+    assert list(np.asarray(offs)) == [0, 9, 9, 11]  # duplicate at 9
+    packed = transducer_pack(dense, f_len, y_len, B * T * U1, offs)
+    # example 2's block starts AT the duplicate offset and must hold
+    # example 2's cells, not example 1's (which has none)
+    np.testing.assert_array_equal(
+        np.asarray(packed)[9:11],
+        np.asarray(dense)[2, :2, :1].reshape(2, H))
+    back = transducer_unpack(packed, f_len, y_len, T, U1, offs, fill=0.0)
+    for b in range(B):
+        fl, w = int(f_len[b]), int(y_len[b]) + 1
+        np.testing.assert_array_equal(np.asarray(back)[b, :fl, :w],
+                                      np.asarray(dense)[b, :fl, :w])
+    # empty examples come back all-fill
+    assert float(jnp.abs(back[1]).max()) == 0.0
+    assert float(jnp.abs(back[3]).max()) == 0.0
+
+
 # -------------------------------------------------- permutation search
 
 def test_permutation_search_improves_retained_magnitude():

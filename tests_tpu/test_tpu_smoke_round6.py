@@ -1,0 +1,78 @@
+"""On-hardware smoke for the round-4 additions: the dropout-enabled
+``flash_attention_with_lse`` kernel path (fused in-kernel PRNG dropout
+composing with the lse output and its cotangent — the ring-attention
+building block, which CPU tests only exercise through the jnp
+fallback). Same contract as the other smoke files: real compiled
+kernels, auto-skipped off-TPU by conftest."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_flash_with_lse_dropout_parity_on_chip():
+    """Kernel-path (hardware PRNG) fwd parity of the (out, lse) entry at
+    dropout 0.1 against composed attention with the SAME keep-mask; lse
+    must stay pre-dropout."""
+    from apex_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        flash_dropout_keep_mask,
+        mha_with_mask_reference,
+    )
+
+    B, H, S, D = 2, 4, 256, 64
+    rate, seed = 0.1, 4242
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        out, lse = jax.jit(lambda q, k, v: flash_attention_with_lse(
+            q, k, v, None, False, 0.125, rate, seed))(q, k, v)
+        keep = flash_dropout_keep_mask(B, H, S, S, rate, seed)
+        ref = mha_with_mask_reference(q, k, v, keep, None, False, 0.125,
+                                      rate)
+        # pre-dropout lse: composed logsumexp, no keep-mask anywhere
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+        lse_ref = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+    assert float(jnp.max(jnp.abs(lse - lse_ref))) < 2e-5
+
+
+def test_flash_with_lse_dropout_grads_with_lse_cotangent_on_chip():
+    """Backward with BOTH cotangents live (out and lse) at dropout>0:
+    the delta - dlse fold and the replayed keep-mask must compose (the
+    first time these two features meet is this path; the ring backward
+    exercises exactly this combination on real meshes)."""
+    from apex_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        flash_dropout_keep_mask,
+    )
+
+    B, H, S, D = 2, 4, 256, 64
+    rate, seed = 0.1, 4242
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    keep = flash_dropout_keep_mask(B, H, S, S, rate, seed)
+
+    def loss_fused(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, None, False, 0.125,
+                                            rate, seed)
+        return jnp.sum(jnp.sin(out)) + 0.1 * jnp.sum(jnp.cos(lse))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+        lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+        p = jnp.exp(s - lse.transpose(0, 1, 3, 2))
+        p = jnp.where(keep, p, 0.0) / (1 - rate)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.sum(jnp.sin(out)) + 0.1 * jnp.sum(jnp.cos(lse))
+
+    with jax.default_matmul_precision("highest"):
+        g = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-4, name
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in g)
