@@ -160,20 +160,27 @@ class BertSelfAttention(nn.Module):
                 init_method=_BERT_INIT, name="qkv")(t)
             qkv = (_sp_exit(qkv_t, B) if cfg.sequence_parallel
                    else qkv_t.reshape(B, -1, 3 * local_h))
+            # Megatron layout: this rank's shard is [q_loc | k_loc | v_loc]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
         else:
-            qkv = _dense(cfg, 3 * h, "qkv")(x)
+            # three flat (B, S, H) projections, NOT one fused qkv + split:
+            # the split is a 3-way copy, and the flat layout feeds the
+            # transpose-free flash entry directly (gradients come back
+            # flat too — no concat in backward)
+            q = _dense(cfg, h, "q")(x)
+            k = _dense(cfg, h, "k")(x)
+            v = _dense(cfg, h, "v")(x)
             nh_local, local_h = nh, h
 
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, -1, nh_local, hd).transpose(0, 2, 1, 3)
-
-        q, k, v = heads(q), heads(k), heads(v)
-
+        # under SP the block input is the (B, S/tp, H) LOCAL shard but
+        # attention runs over the FULL sequence (q is full-S after the
+        # SP gather inside ColumnParallelLinear) — gate on the full
+        # length, not the shard
+        full_seq = x.shape[1] * (tp if (cfg.use_tensor_parallel
+                                        and cfg.sequence_parallel) else 1)
         use_flash = (
             cfg.fused_kernels and cfg.flash_attention
-            and q.shape[2] >= cfg.flash_min_seq
+            and full_seq >= cfg.flash_min_seq
             # flash takes a BOOLEAN per-key padding mask; the (B, 1, 1, Sk)
             # convention from BertModel reduces to it exactly. Additive
             # float masks must go through the composed-softmax path.
@@ -184,7 +191,7 @@ class BertSelfAttention(nn.Module):
                      and attention_mask.shape[2] == 1))
         )
         if use_flash:
-            from apex_tpu.ops.flash_attention import flash_attention
+            from apex_tpu.ops.flash_attention import flash_attention_bsh
 
             key_mask = (None if attention_mask is None
                         else attention_mask[:, 0, 0, :])
@@ -193,10 +200,18 @@ class BertSelfAttention(nn.Module):
             # heads are sharded under TP, so fold the TP rank in
             seed = (_dropout_seed(self, cfg.use_tensor_parallel)
                     if drop > 0.0 else None)
-            ctx = flash_attention(q, k, v, key_mask, False, inv_sqrt,
-                                  drop, seed)
+            # (B, S, H)-layout kernels: no head split/merge transposes
+            # (falls back to the transposed entry off the single-tile
+            # regime — see ops/flash_attention.py)
+            ctx = flash_attention_bsh(q, k, v, key_mask, nh_local, False,
+                                      inv_sqrt, drop, seed)
+            ctx = ctx.astype(cfg.dtype)
         else:
-            scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+            def heads(t):
+                return t.reshape(B, -1, nh_local, hd).transpose(0, 2, 1, 3)
+
+            qh, kh, vh = heads(q), heads(k), heads(v)
+            scores = jnp.einsum("bnqd,bnkd->bnqk", qh, kh,
                                 preferred_element_type=jnp.float32) * inv_sqrt
             probs = _attn_softmax(cfg, scores.astype(cfg.dtype), attention_mask)
             # attention probs are head-sharded under TP: per-rank masks
@@ -204,9 +219,10 @@ class BertSelfAttention(nn.Module):
                                tp_varying=cfg.use_tensor_parallel,
                                fused=cfg.fused_kernels)(
                 probs, deterministic=deterministic)
-            ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), v,
+            ctx = jnp.einsum("bnqk,bnkd->bnqd", probs.astype(cfg.dtype), vh,
                              preferred_element_type=jnp.float32)
-        ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, -1, local_h)
+            ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(
+                B, -1, local_h)
 
         if cfg.use_tensor_parallel:
             from apex_tpu.transformer.tensor_parallel import RowParallelLinear

@@ -96,18 +96,20 @@ def _tile_id(b, h, iq, ik, H, nq, nk):
     return ((b * H + h) * nq + iq) * nk + ik
 
 
-def _keep_mask(drop_ref, tile_id, bq, bk, dropout_rate, native_prng):
+def _keep_mask(drop_ref, tile_id, bq, bk, dropout_rate, native_prng,
+               interp_idx=(0, 0)):
     """(bq, bk) boolean keep-mask for one score tile.
 
     native_prng: seed the TPU hardware PRNG with (user seed, tile id) —
     any kernel regenerates the identical mask for the same tile.
-    Otherwise drop_ref is a precomputed (1, 1, bq, bk) uint32 block
-    (interpret mode)."""
+    Otherwise drop_ref is a precomputed uint32 block (interpret mode)
+    and ``interp_idx`` selects the (bq, bk) slice (head-pair kernels
+    carry two heads per block)."""
     if native_prng:
         pltpu.prng_seed(drop_ref[0], tile_id)
         bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.uint32)
     else:
-        bits = drop_ref[0, 0]
+        bits = drop_ref[interp_idx]
     return bits < _keep_threshold(dropout_rate)
 
 
@@ -905,3 +907,334 @@ def _fwl_bwd(causal, scale, dropout_rate, res, cotangents):
 
 
 flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (B, S, NH*D)-layout entry: attention without head split/merge transposes
+# ---------------------------------------------------------------------------
+#
+# The transposed (B, NH, S, D) convention costs the model 4 layout copies
+# per layer forward (q, k, v head-split + context merge) and their 4
+# mirrors in backward — ~8 x 17 MB of pure HBM traffic per BERT-large
+# layer. Here the kernel reads heads directly out of the flat activation
+# via the BlockSpec index map and writes the context back the same way,
+# so the model keeps everything (B, S, H) end to end.
+#
+# Mosaic requires lane-dim blocks to be multiples of 128, so a D=64 head
+# cannot be block-sliced alone out of a 1024-lane activation; instead
+# each grid step owns a HEAD PAIR — a (1, S, 2*D=128) block holding
+# heads 2h and 2h+1 side by side — and the kernel computes the two
+# heads' attention from in-register lane slices of the pair. (This also
+# halves the grid, amortizing per-step overheads.) Constraints for the
+# kernel path: 2*D % 128 == 0, even NH, and the single-tile sequence
+# regime (S <= 512 — the flagship shape); anything else falls back to
+# the transposed entry transparently.
+
+
+def _fwd_single_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, *rest, scale,
+                           causal, bq, bk, NH, D, dropout_rate=0.0,
+                           native_prng=True):
+    """Head-pair single-tile forward on (B, S, NH*D)-layout refs: the
+    (1, bq, 2D) blocks hold heads 2h and 2h+1; same math as
+    _fwd_single_kernel per head."""
+    if dropout_rate > 0.0:
+        drop_ref, o_ref, lse_ref = rest
+    else:
+        drop_ref, (o_ref, lse_ref) = None, rest
+    b, hp = pl.program_id(0), pl.program_id(1)
+    mrow = mask_ref[0, 0][None, :]
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]       # (bq, 2D)
+    prec = _prec(q2.dtype)
+    outs = []
+    for j in (0, 1):
+        q = q2[:, j * D:(j + 1) * D]
+        k = k2[:, j * D:(j + 1) * D]
+        s = _dot(q, k, ((1,), (1,)), prec) * scale
+        s = jnp.where(mrow != 0, FILL, s)
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(row >= col, s, FILL)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = jnp.where(mrow >= 2, 0.0, p)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            # per-HEAD tile id (2*hp + j): identical mask stream to the
+            # transposed entry at the same (b, h) coordinates
+            tid = _tile_id(b, 2 * hp + j, 0, 0, NH, 1, 1)
+            keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate,
+                              native_prng, interp_idx=(0, j))
+            p_av = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_av = p
+        v = v2[:, j * D:(j + 1) * D]
+        pv = _dot(p_av.astype(v.dtype), v, ((1,), (0,)), prec)
+        safe_l = jnp.where(l > 0, l, 1.0)
+        outs.append((pv / safe_l).astype(o_ref.dtype))
+        lse_ref[0, j, 0] = (m + jnp.log(safe_l))[:, 0]
+    o_ref[0] = jnp.concatenate(outs, axis=1)
+
+
+def _bwd_fused_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                          delta_ref, *rest, scale, causal, bq, bk, NH, D,
+                          dropout_rate=0.0, native_prng=True):
+    """Head-pair single-tile fused backward on (B, S, NH*D)-layout refs:
+    recomputes s and p once per head and emits dq, dk, dv for the pair
+    (same 5-matmul-per-head economy as _bwd_fused_kernel)."""
+    if dropout_rate > 0.0:
+        drop_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        drop_ref, (dq_ref, dk_ref, dv_ref) = None, rest
+    b, hp = pl.program_id(0), pl.program_id(1)
+    mrow = mask_ref[0, 0][None, :]
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    prec = _prec(q2.dtype)
+    dqs, dks, dvs = [], [], []
+    for j in (0, 1):
+        q = q2[:, j * D:(j + 1) * D]
+        k = k2[:, j * D:(j + 1) * D]
+        s = _dot(q, k, ((1,), (1,)), prec) * scale
+        s = jnp.where(mrow != 0, FILL, s)
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(row >= col, s, FILL)
+        lse = lse_ref[0, j, 0][:, None]
+        p = jnp.exp(s - lse)
+        p = jnp.where(mrow >= 2, 0.0, p)
+        do = do2[:, j * D:(j + 1) * D]
+        v = v2[:, j * D:(j + 1) * D]
+        dp = _dot(do, v, ((1,), (1,)), prec)
+        if dropout_rate > 0.0:
+            tid = _tile_id(b, 2 * hp + j, 0, 0, NH, 1, 1)
+            keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate,
+                              native_prng, interp_idx=(0, j))
+            inv_keep = 1.0 / (1.0 - dropout_rate)
+            p_av = jnp.where(keep, p, 0.0) * inv_keep
+            dp = jnp.where(keep, dp, 0.0) * inv_keep
+        else:
+            p_av = p
+        dvs.append(_dot(p_av.astype(do.dtype), do, ((0,), (0,)),
+                        prec).astype(dv_ref.dtype))
+        delta = delta_ref[0, j, 0][:, None]
+        ds = p * (dp - delta) * scale
+        dqs.append(_dot(ds.astype(k.dtype), k, ((1,), (0,)),
+                        prec).astype(dq_ref.dtype))
+        dks.append(_dot(ds.astype(q.dtype), q, ((0,), (0,)),
+                        prec).astype(dk_ref.dtype))
+    dq_ref[0] = jnp.concatenate(dqs, axis=1)
+    dk_ref[0] = jnp.concatenate(dks, axis=1)
+    dv_ref[0] = jnp.concatenate(dvs, axis=1)
+
+
+def _bsh_spec(bs, D2):
+    """BlockSpec slicing head pair hp of a (B, S_padded, NH*D) tensor
+    (lane block 2D, a 128 multiple)."""
+    return pl.BlockSpec((1, bs, D2), lambda b, hp: (b, 0, hp))
+
+
+def _bsh_drop_arg(drop_in, bq, bk):
+    """Dropout input for the pair kernels: scalar seed (native) or the
+    (B, NH, Sqp, Skp) bits tensor blocked (1, 2, bq, bk) per pair."""
+    if drop_in is None:
+        return [], []
+    if drop_in.ndim == 1:
+        return [drop_in], [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    return [drop_in], [pl.BlockSpec((1, 2, bq, bk),
+                                    lambda b, hp: (b, hp, 0, 0))]
+
+
+def _flash_fwd_call_bsh(q, k, v, mask, *, scale, causal, bq, bk, NH, D,
+                        dropout_rate=0.0, drop_in=None):
+    B, Sp, _ = q.shape
+    native = drop_in is not None and drop_in.ndim == 1
+    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk)
+    return pl.pallas_call(
+        functools.partial(_fwd_single_kernel_bsh, scale=scale,
+                          causal=causal, bq=bq, bk=bk, NH=NH, D=D,
+                          dropout_rate=dropout_rate, native_prng=native),
+        grid=(B, NH // 2),
+        in_specs=[
+            _bsh_spec(bq, 2 * D),
+            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bk, 2 * D),
+            pl.BlockSpec((1, 1, bk), lambda b, hp: (b, 0, 0)),
+        ] + extra_specs,
+        out_specs=(
+            _bsh_spec(bq, 2 * D),
+            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+        ),
+        out_shape=(
+            out_struct((B, Sp, NH * D), q.dtype, q, k, v),
+            out_struct((B, NH, 1, Sp), jnp.float32, q, k, v),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, mask, *extra)
+
+
+def _flash_bwd_call_bsh(q, k, v, mask, do, lse, delta, *, scale, causal,
+                        bq, bk, NH, D, dropout_rate=0.0, drop_in=None):
+    B, Sp, _ = q.shape
+    native = drop_in is not None and drop_in.ndim == 1
+    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk)
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel_bsh, scale=scale,
+                          causal=causal, bq=bq, bk=bk, NH=NH, D=D,
+                          dropout_rate=dropout_rate, native_prng=native),
+        grid=(B, NH // 2),
+        in_specs=[
+            _bsh_spec(bq, 2 * D),
+            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bk, 2 * D),
+            pl.BlockSpec((1, 1, bk), lambda b, hp: (b, 0, 0)),
+            _bsh_spec(bq, 2 * D),
+            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+        ] + extra_specs,
+        out_specs=(
+            _bsh_spec(bq, 2 * D),
+            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bk, 2 * D),
+        ),
+        out_shape=(
+            out_struct((B, Sp, NH * D), q.dtype, q, k, v, do),
+            out_struct((B, Sp, NH * D), k.dtype, q, k, v, do),
+            out_struct((B, Sp, NH * D), v.dtype, q, k, v, do),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, mask, do, lse, delta, *extra)
+
+
+def _bsh_kernel_ok(S, H, num_heads):
+    """Static gate for the bsh kernel path: head pairs must tile the
+    128-lane block exactly, and the single-tile regime must hold."""
+    if H % num_heads:
+        return False
+    D = H // num_heads
+    if num_heads % 2 or (2 * D) % 128:
+        return False
+    bq = _block_dim(S)
+    return _round_up(S, bq) == bq  # single tile after padding
+
+
+def _bsh_transpose_fallback(q, k, v, key_mask, num_heads, causal, scale,
+                            dropout_rate, dropout_seed):
+    B, S, H = q.shape
+    D = H // num_heads
+
+    def split(t):
+        return t.reshape(B, S, num_heads, D).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split(q), split(k), split(v), key_mask, causal,
+                          scale, dropout_rate, dropout_seed)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, H)
+
+
+def _bsh_pad(q, k, v, key_mask, bq):
+    """Row-pad (B, S, H) activations to the block size; padded keys get
+    mask code 2 (excluded from the softmax denominator)."""
+    B, S, H = q.shape
+    Sp = _round_up(S, bq)
+    if key_mask is None:
+        mask = jnp.zeros((B, 1, S), jnp.int32)
+    else:
+        mask = key_mask.astype(jnp.int32)[:, None, :]
+    if Sp != S:
+        q = jnp.pad(q, ((0, 0), (0, Sp - S), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, Sp - S)),
+                       constant_values=2)
+    return q, k, v, mask
+
+
+def flash_attention_bsh(q, k, v, key_mask=None, num_heads=None,
+                        causal: bool = False, scale: float = 1.0,
+                        dropout_rate: float = 0.0, dropout_seed=None):
+    """Flash attention on flat (B, S, NH*D) activations — no head
+    split/merge transposes anywhere. Heads are interleaved in the lane
+    dim (head h owns columns [h*D, (h+1)*D)); the kernel slices them via
+    its BlockSpec index maps, and gradients come back in the same flat
+    layout. Semantics (masking, causal, fused dropout, seeds) are
+    identical to :func:`flash_attention` on the transposed layout.
+
+    Falls back to transpose + :func:`flash_attention` when the kernel
+    constraints don't hold (D not a multiple of 64, or S beyond the
+    single-tile regime), and to the composed reference under shard_map
+    on CPU — callers use one entry everywhere.
+    """
+    if num_heads is None:
+        raise ValueError("flash_attention_bsh requires num_heads")
+    B, S, H = q.shape
+    if use_jnp_fallback(q, k, v, key_mask) or not _bsh_kernel_ok(
+            S, H, num_heads):
+        return _bsh_transpose_fallback(q, k, v, key_mask, num_heads,
+                                       causal, scale, dropout_rate,
+                                       dropout_seed)
+    return _flash_bsh_core(q, k, v, key_mask, num_heads, causal, scale,
+                           dropout_rate, dropout_seed)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bsh_core(q, k, v, key_mask, num_heads, causal, scale,
+                    dropout_rate, dropout_seed=None):
+    out, _ = _bsh_fwd_impl(q, k, v, key_mask, num_heads, causal, scale,
+                           dropout_rate, dropout_seed)
+    return out
+
+
+def _bsh_fwd_impl(q, k, v, key_mask, num_heads, causal, scale,
+                  dropout_rate, dropout_seed):
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "flash_attention_bsh with dropout_rate > 0 requires "
+            "dropout_seed")
+    B, S, H = q.shape
+    D = H // num_heads
+    bq = bk = _block_dim(S)
+    qp, kp, vp, mask = _bsh_pad(q, k, v, key_mask, bq)
+    drop_in = _drop_input(dropout_rate, dropout_seed, B, num_heads,
+                          qp.shape[1], kp.shape[1])
+    out, lse = _flash_fwd_call_bsh(qp, kp, vp, mask, scale=scale,
+                                   causal=causal, bq=bq, bk=bk,
+                                   NH=num_heads, D=D,
+                                   dropout_rate=dropout_rate,
+                                   drop_in=drop_in)
+    return out[:, :S], lse
+
+
+def _bsh_vjp_fwd(q, k, v, key_mask, num_heads, causal, scale,
+                 dropout_rate, dropout_seed=None):
+    out, lse = _bsh_fwd_impl(q, k, v, key_mask, num_heads, causal, scale,
+                             dropout_rate, dropout_seed)
+    return out, (q, k, v, key_mask, out, lse, dropout_seed)
+
+
+def _bsh_vjp_bwd(num_heads, causal, scale, dropout_rate, res, g):
+    q, k, v, key_mask, out, lse, dropout_seed = res
+    B, S, H = q.shape
+    D = H // num_heads
+    bq = bk = _block_dim(S)
+    qp, kp, vp, mask = _bsh_pad(q, k, v, key_mask, bq)
+    Sp = qp.shape[1]
+    drop_in = _drop_input(dropout_rate, dropout_seed, B, num_heads, Sp, Sp)
+    gp, outp = g, out
+    if Sp != S:
+        gp = jnp.pad(g, ((0, 0), (0, Sp - S), (0, 0)))
+        outp = jnp.pad(out, ((0, 0), (0, Sp - S), (0, 0)))
+    # per-head delta = rowsum_D(dO * O): (B, Sp, NH) -> (B, NH, 1, Sp)
+    delta = (gp.astype(jnp.float32) * outp.astype(jnp.float32)).reshape(
+        B, Sp, num_heads, D).sum(-1).transpose(0, 2, 1)[:, :, None, :]
+    dq, dk, dv = _flash_bwd_call_bsh(qp, kp, vp, mask, gp, lse, delta,
+                                     scale=scale, causal=causal, bq=bq,
+                                     bk=bk, NH=num_heads, D=D,
+                                     dropout_rate=dropout_rate,
+                                     drop_in=drop_in)
+    return (match_vma(dq[:, :S].astype(q.dtype), q),
+            match_vma(dk[:, :S].astype(k.dtype), k),
+            match_vma(dv[:, :S].astype(v.dtype), v),
+            None, None)
+
+
+_flash_bsh_core.defvjp(_bsh_vjp_fwd, _bsh_vjp_bwd)

@@ -116,20 +116,77 @@ def build_step(cfg_kwargs, opt_level, batch, seq):
     return jitted, [(params, ost, sst, jax.random.PRNGKey(_SALT))], model_info
 
 
+def marginal_time(advance, fetch, iters, windows=2):
+    """Marginal-fetch timing (round-4 methodology) — THE one timing
+    primitive for this runtime; time_steps, _chain_time, and
+    tools/profile_step.py all delegate here so a methodology fix lands
+    once.
+
+    Every window of chained steps on this runtime carries a constant
+    synchronization cost on top of the real compute — measured ~100-140
+    ms whether the window ends in ``block_until_ready`` or a value
+    fetch (and for some programs ``block_until_ready``/``is_ready``
+    return EARLY with the work still pending, so a value fetch is the
+    only reliable barrier). Dividing a single window by its iteration
+    count therefore inflates every step by overhead/iters — the round-3
+    numbers carried ~+12 ms/step of pure window overhead.
+
+    The fix: time two windows of different lengths, each ended by a
+    value fetch, and report the MARGINAL cost
+    (T_big - T_small) / (n_big - n_small). The constant cancels; what
+    remains is the sustained per-step cost a real training loop pays
+    (it blocks rarely, so the sustained rate IS the marginal rate).
+    Verified linear: T(n) = n*dt + c fits windows of 2 and 6 BERT-large
+    steps to <1%.
+
+    Noise guard: a tunnel-latency spike landing in a small window can
+    push the marginal non-positive (the sync constant swings +/-30%);
+    non-positive marginals are DISCARDED, and if every window pair is
+    corrupted the fallback is the big window's mean (a conservative
+    upper bound, never negative).
+
+    Args:
+      advance: ``advance(n)`` runs n chained steps (state must evolve
+        through every call — the runtime memoizes repeated inputs).
+      fetch: value-fetch barrier returning a float that depends on the
+        full step output.
+      iters: big-window length; the small window is ``max(iters//4, 1)``.
+    """
+    n_small = max(iters // 4, 1)
+    marginals = []
+    t_big_last = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        advance(n_small)
+        fetch()
+        t_small = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        advance(iters)
+        fetch()
+        t_big = time.perf_counter() - t0
+        t_big_last = t_big
+        dt = (t_big - t_small) / (iters - n_small)
+        if dt > 0:
+            marginals.append(dt)
+    if not marginals:  # every pair noise-corrupted: conservative bound
+        marginals.append(t_big_last / iters)
+    return min(marginals)
+
+
 def time_steps(jitted, state_box, warmup=2, iters=8):
+    """Headline-step timing via :func:`marginal_time`."""
     params, ost, sst, key = state_box.pop()  # take ownership; see build_step
+    loss = None
     for _ in range(warmup):
         params, ost, sst, loss, key = jitted(params, ost, sst, key)
-    # Block on the FULL output tree: on this runtime individual buffers
-    # become ready as they are produced, and `loss` only depends on the
-    # forward pass — blocking on it alone under-measures the step by the
-    # entire backward + optimizer tail (observed 35x at S=512).
-    jax.block_until_ready((params, ost, sst, loss))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, ost, sst, loss, key = jitted(params, ost, sst, key)
-    jax.block_until_ready((params, ost, sst, loss))
-    dt = (time.perf_counter() - t0) / iters
+    float(loss)  # value fetch: the only reliable execution barrier
+
+    def advance(n):
+        nonlocal params, ost, sst, key, loss
+        for _ in range(n):
+            params, ost, sst, loss, key = jitted(params, ost, sst, key)
+
+    dt = marginal_time(advance, lambda: float(loss), iters)
     return dt, float(loss)
 
 
@@ -198,57 +255,68 @@ def _measure(batch, seq, iters, with_baseline=True, remat=True):
     return dt_opt, dt_base, mfu
 
 
-def _chain_time(step, state, iters, warmup=2, windows=3):
-    """Bench-style reliable timing: state evolves through every call
-    (defeats any runtime result caching), block once at the end of each
-    window; best-of-``windows`` guards the microbench ratios against
-    tunnel-latency noise (observed run-to-run swings of +/-30% on
-    single-window measurements)."""
+def _fetch(state):
+    """Value fetch of one element: the only reliable execution barrier
+    on this runtime (block_until_ready/is_ready return early for some
+    chained programs — see marginal_time)."""
+    leaf = jax.tree.leaves(state)[0]
+    return float(jnp.sum(leaf))
+
+
+def _chain_time(step, state, iters, warmup=2, windows=2):
+    """Microbench timing via :func:`marginal_time`: state evolves
+    through every call (defeats the runtime's result memoization)."""
     for _ in range(warmup):
         state = step(*state)
-    jax.block_until_ready(state)
-    best = None
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state = step(*state)
-        jax.block_until_ready(state)
-        dt = (time.perf_counter() - t0) / iters
-        best = dt if best is None else min(best, dt)
-    return best
+    _fetch(state)
+    box = [state]
+
+    def advance(n):
+        for _ in range(n):
+            box[0] = step(*box[0])
+
+    return marginal_time(advance, lambda: _fetch(box[0]), iters,
+                         windows=windows)
 
 
 def bench_layer_norm():
     """BASELINE configs[1]: FusedLayerNorm (Pallas training path) vs
-    stock-XLA LN, fwd+bwd at the BERT-large shape. Value = speedup (x)."""
+    stock-XLA LN, fwd+bwd at the BERT-large shape. Value = speedup (x).
+
+    Sizing note (round 4): each timed call runs 32 chained LN fwd+bwd
+    applications so one call costs tens of ms — the per-window sync
+    noise on this runtime swings tens of ms, and a smaller workload
+    (round 3 used 8 applications) left the ratio inside the noise floor
+    (recorded values 0.99-1.05x carried no regression information)."""
     from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 
     x0 = jax.random.normal(jax.random.PRNGKey(_SALT), (16 * 512, 1024),
-                           jnp.bfloat16)
+                           jnp.float32)
     w = jnp.ones((1024,), jnp.float32)
     b = jnp.zeros((1024,), jnp.float32)
 
     from apex_tpu.ops.layer_norm import layer_norm_reference as stock_ln
 
     def mk(fn):
+        def many(xb, w, b):
+            for _ in range(32):
+                xb = fn(xb, w, b) + xb * 0.5
+            return xb
+
         @jax.jit
         def step(x):
-            dx = jax.grad(lambda x: jnp.sum(fn(x, w, b).astype(jnp.float32)
-                                            ** 2))(x)
-            return (x - 1e-6 * dx.astype(x.dtype),)
+            def loss(x):
+                return jnp.sum(many(x.astype(jnp.bfloat16), w, b)
+                               .astype(jnp.float32) ** 2)
+            dx = jax.grad(loss)(x)
+            # f32 carry with a bounded f32-visible update: a bf16 carry
+            # with a tiny step rounds back to the identical input and
+            # the runtime memoizer serves the call from cache
+            return (0.999 * x - 1e-3 * jnp.tanh(dx),)
         return step
 
-    # 64 LN applications per timed call (amortizes dispatch); per-call
-    # time still chains through x
-    def rep(fn):
-        def many(x, w, b):
-            for _ in range(8):
-                x = fn(x, w, b) + x * 0.5
-            return x
-        return many
-
-    dt_fused = _chain_time(mk(rep(fused_layer_norm_affine)), (x0,), iters=8)
-    dt_stock = _chain_time(mk(rep(stock_ln)), (x0,), iters=8)
+    dt_fused = _chain_time(mk(fused_layer_norm_affine), (x0,), iters=8)
+    dt_stock = _chain_time(mk(stock_ln), (x0,), iters=8)
     return {
         "metric": "fused_layer_norm_fwdbwd_speedup_vs_xla",
         "value": round(dt_stock / dt_fused, 3),
@@ -277,12 +345,16 @@ def bench_fused_lamb():
 
     opt = FusedLAMB(lr=1e-3)
 
+    # 4 chained optimizer steps per timed call: one step is ~1-2 ms,
+    # below the runtime's window-noise floor (same sizing rationale as
+    # bench_layer_norm)
     @jax.jit
     def fused_step(params, ost):
-        p2, ost2 = opt.step(grads, ost, params)
-        return p2, ost2
+        for _ in range(4):
+            params, ost = opt.step(grads, ost, params)
+        return params, ost
 
-    def eager_step_body(params, m, v, step):
+    def eager_one(params, m, v, step):
         # per-leaf unfused chain: the torch-eager per-param analog
         step = step + 1
         new_p, new_m, new_v = {}, {}, {}
@@ -300,7 +372,11 @@ def bench_fused_lamb():
             new_m[k], new_v[k] = m_k, v_k
         return new_p, new_m, new_v, step
 
-    eager_step = jax.jit(eager_step_body)
+    @jax.jit
+    def eager_step(params, m, v, step):
+        for _ in range(4):  # same 4-step chaining as fused_step
+            params, m, v, step = eager_one(params, m, v, step)
+        return params, m, v, step
 
     ost0 = opt.init(leaves)
     dt_fused = _chain_time(fused_step, (leaves, ost0), iters=20)
@@ -316,8 +392,36 @@ def bench_fused_lamb():
     }
 
 
+_HLO_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
+                    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1,
+                    "u8": 1, "pred": 1}
+
+
+def count_allreduce_bytes(hlo_text):
+    """(op_count, total_bytes) of all-reduce collectives in compiled HLO
+    text — the framework-attributable synchronization traffic of a step,
+    exactly measurable where wall-clock on a shared-core virtual mesh is
+    not. Handles scalar, array, and tuple-shaped all-reduces."""
+    import re
+
+    ops, total = 0, 0
+    for line in hlo_text.splitlines():
+        m = re.search(r"=\s*(.*?)\s+all-reduce(?:-start)?\(", line)
+        if not m:
+            continue
+        ops += 1
+        for dt, dims in re.findall(r"([a-z]+\d+|pred)\[([\d,]*)\]",
+                                   m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _HLO_DTYPE_BYTES.get(dt, 4)
+    return ops, total
+
+
 _DDP_SCALING_CHILD = r"""
-import json, time, sys
+import json, sys
 import jax, jax.numpy as jnp, numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -366,28 +470,35 @@ variables = jax.jit(jax.shard_map(
 step = jax.jit(jax.shard_map(
     train_step, mesh=mesh, in_specs=(P(), P("data"), P("data")),
     out_specs=P()))
-for _ in range(5):
-    variables = step(variables, xb, yb)
-jax.block_until_ready(variables)
-best = None
-for _ in range(3):  # best-of-3 windows: shared-core CPU sim is noisy
-    t0 = time.perf_counter()
-    for _ in range(20):
-        variables = step(variables, xb, yb)
-    jax.block_until_ready(variables)
-    dt = (time.perf_counter() - t0) / 20
-    best = dt if best is None else min(best, dt)
-print(json.dumps({"dt": best}))
+hlo = step.lower(variables, xb, yb).compile().as_text()
+grad_bytes = sum(l.size * 4 for l in jax.tree.leaves(variables["params"]))
+sys.path.insert(0, sys.argv[3])
+import bench
+ops, bytes_ = bench.count_allreduce_bytes(hlo)
+print(json.dumps({"ops": ops, "bytes": bytes_, "grad_bytes": grad_bytes}))
 """
 
 
 def bench_ddp_scaling():
     """BASELINE configs[3] (virtual-device proxy for the 8->64->256 pod
     sweep, which needs hardware this harness doesn't have): the
-    framework-attributable cost of DDP+SyncBN synchronization at dp=8 —
-    step time WITHOUT the grad allreduce over step time WITH it, ideal
-    1.0 (see the NOTE below on why wall-clock weak scaling is not
-    measurable on a shared-core virtual mesh)."""
+    framework-attributable DDP+SyncBN synchronization traffic at dp=8,
+    measured from the compiled HLO — all-reduce bytes per step over the
+    ideal one-pass-over-the-gradients bytes. Ideal is slightly above
+    1.0 (SyncBN's welford-triple psums ride on top of the grad sync);
+    a regression that syncs twice, syncs in a wider dtype, or adds
+    per-layer collectives moves the ratio — unlike the round-3
+    wall-clock ratio, which sat pinned at its 1.0 clamp because the
+    sync cost of this net is below CPU-sim timing noise.
+
+    Audit note (round 4): an explicit-allreduce-removed variant
+    compiles to the IDENTICAL program — shard_map AD inserts the
+    boundary psum for the replicated params itself, and the vma-aware
+    DistributedDataParallel.allreduce_grads recognizes already-invariant
+    grads and skips its own sync (the round-2 varying-axes feature
+    working as designed). The deliberate-regression demonstration
+    (doubled sync moves the metric) lives in
+    tests/test_bench_metrics.py."""
     import os
     import subprocess
 
@@ -396,31 +507,27 @@ def bench_ddp_scaling():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8")
+    here = os.path.dirname(os.path.abspath(__file__))
 
     def run(mode, dp=8):
         out = subprocess.run(
-            [sys.executable, "-c", _DDP_SCALING_CHILD, str(dp), mode],
+            [sys.executable, "-c", _DDP_SCALING_CHILD, str(dp), mode, here],
             capture_output=True, text=True, timeout=600, env=env)
         if out.returncode != 0:
             raise RuntimeError(out.stderr[-500:])
-        return json.loads(out.stdout.strip().splitlines()[-1])["dt"]
+        return json.loads(out.stdout.strip().splitlines()[-1])
 
-    # NOTE on the metric definition: true 8->64->256 weak scaling needs
-    # pod hardware this harness doesn't have, and on the virtual CPU
-    # mesh all "devices" share one host's cores, so wall-clock weak
-    # scaling would measure the host, not the framework. The framework-
-    # attributable quantity IS measurable: the step-time overhead the
-    # DDP+SyncBN gradient/stat synchronization adds at dp=8 (sync step
-    # vs the identical step with the grad allreduce removed).
-    dt_sync = run("sync")
-    dt_nosync = run("nosync")
-    # clamp: >1 means the sync overhead is below CPU-sim timing noise
-    eff = min(dt_nosync / dt_sync, 1.0)
+    stats = run("sync")
+    ratio = stats["bytes"] / stats["grad_bytes"]
+    print(f"# ddp collective audit: {stats['ops']} all-reduces "
+          f"({stats['bytes']} B) vs grad bytes {stats['grad_bytes']}",
+          file=sys.stderr)
     return {
-        "metric": "ddp_syncbn_grad_sync_efficiency_8dev_cpu_sim",
-        "value": round(eff, 3),
+        "metric": "ddp_syncbn_allreduce_bytes_over_grad_bytes_8dev",
+        "value": round(ratio, 3),
         "unit": "ratio",
-        "vs_baseline": round(eff, 3),
+        "vs_baseline": round(ratio, 3),
+        "allreduce_ops": stats["ops"],
     }
 
 

@@ -41,7 +41,7 @@ def test_bf16_training_step_with_amp_o2_and_lamb():
     opt = FusedLAMB(lr=1e-3)
     params, opt, handle = amp.initialize(params, opt, opt_level="O2", verbosity=0)
     # O2: dense kernels bf16, LN params fp32, masters on
-    assert params["bert"]["layer_0"]["attention"]["qkv"]["kernel"].dtype == jnp.bfloat16
+    assert params["bert"]["layer_0"]["attention"]["q"]["kernel"].dtype == jnp.bfloat16
     assert params["bert"]["layer_0"]["attention_ln"]["scale"].dtype == jnp.float32
     assert opt.master_weights
     ost = opt.init(params)

@@ -76,3 +76,46 @@ def test_flash_with_lse_dropout_grads_with_lse_cotangent_on_chip():
     for name, a, b in zip("qkv", g, gr):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-4, name
     assert all(bool(jnp.all(jnp.isfinite(x))) for x in g)
+
+
+def test_flash_bsh_bitwise_matches_transposed_on_chip():
+    """The (B, S, NH*D)-layout head-pair kernels must produce BITWISE
+    the same outputs, gradients, and hardware-PRNG dropout masks as the
+    transposed (B, NH, S, D) entry at the flagship shape."""
+    from apex_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_bsh,
+    )
+
+    B, S, NH, D = 2, 512, 16, 64
+    H = NH * D
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H), jnp.bfloat16)
+
+    def split(t):
+        return t.reshape(B, S, NH, D).transpose(0, 2, 1, 3)
+
+    def merge(t):
+        return t.transpose(0, 2, 1, 3).reshape(B, S, H)
+
+    rate, seed = 0.1, 77
+    out = jax.jit(lambda q, k, v: flash_attention_bsh(
+        q, k, v, None, NH, False, 0.125, rate, seed))(q, k, v)
+    ref = jax.jit(lambda q, k, v: merge(flash_attention(
+        split(q), split(k), split(v), None, False, 0.125, rate,
+        seed)))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def loss(f, q):
+        return jnp.sum(f(q).astype(jnp.float32) ** 2)
+
+    g1 = jax.jit(jax.grad(lambda q: loss(
+        lambda a: flash_attention_bsh(a, k, v, None, NH, False, 0.125,
+                                      rate, seed), q)))(q)
+    g2 = jax.jit(jax.grad(lambda q: loss(
+        lambda a: merge(flash_attention(split(a), split(k), split(v),
+                                        None, False, 0.125, rate, seed)),
+        q)))(q)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))

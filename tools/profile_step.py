@@ -1,0 +1,229 @@
+"""Component-level on-chip profile of the BERT-large headline step.
+
+Chained-carry timing (the only trustworthy pattern on the axon tunnel —
+see .claude/skills/verify/SKILL.md): state evolves through every call,
+block once per window, best-of-3 windows, salted inputs. Each component
+is timed fwd+bwd in isolation so the 210-ish ms step decomposes into an
+actionable budget (attention kernels / encoder matmuls / MLM tail /
+optimizer) against the 141 TFLOP/s measured matmul ceiling.
+
+Usage:  python tools/profile_step.py [component ...]
+        components: attn encoder tail step matmul (default: all)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SALT = int(time.time() * 1e3) % (2 ** 30)
+
+B, S, H, NH, D, L, I = 16, 512, 1024, 16, 64, 24, 4096
+V = 30522
+PEAK = 197e12
+
+
+def _chain(step, state, iters=8, warmup=2, windows=2):
+    """Delegates to bench.marginal_time — ONE timing methodology for
+    the whole repo (value-fetch barrier + positive-marginal guard)."""
+    import bench
+
+    for _ in range(warmup):
+        state = step(*state)
+    bench._fetch(state)
+    box = [state]
+
+    def advance(n):
+        for _ in range(n):
+            box[0] = step(*box[0])
+
+    return bench.marginal_time(advance, lambda: bench._fetch(box[0]),
+                               iters, windows=windows)
+
+
+def _reset():
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+
+
+def prof_attention():
+    """24 layers of flash attention (B, NH, S, D) fwd+bwd, dropout 0.1."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    # fp32 carry: a bf16 carry with a tiny update rounds back to the
+    # IDENTICAL input and the runtime memoizer serves the whole step
+    # from cache (observed: 0.02 ms "measurement")
+    q = jax.random.normal(jax.random.PRNGKey(_SALT), (B, NH, S, D),
+                          jnp.float32)
+
+    def loss(qc):
+        x = qc.astype(jnp.bfloat16)
+        for i in range(L):
+            x = flash_attention(x, x, x, None, False, 0.125, 0.1,
+                                _SALT + i)
+        return jnp.sum(x.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def step(q):
+        dq = jax.grad(loss)(q)
+        return (0.999 * q - 1e-3 * jnp.tanh(dq),)
+
+    dt = _chain(step, (q,))
+    # useful flops: 4*B*S^2*H per layer fwd, 3 matmuls of same size in
+    # bwd (recompute s + dq/dk/dv/dp makes it 5+2 kernel matmuls, but
+    # the MFU convention counts fwd 2 + bwd 4 matmul-equivalents)
+    flops = 12.0 * L * B * S * S * H
+    print(f"attention x{L} fwd+bwd (dropout .1): {dt*1e3:7.2f} ms  "
+          f"({flops/dt/1e12:5.1f} TFLOP/s conv, {flops/dt/PEAK:.3f} MFU; "
+          f"kernel does 7/6 of counted matmuls)")
+    return dt
+
+
+def prof_encoder():
+    """Encoder-only (BertModel, no heads/loss/optimizer) fwd+bwd at the
+    true dropout config."""
+    from apex_tpu.models import BertConfig, BertModel
+
+    cfg = BertConfig.bert_large(dtype=jnp.bfloat16, remat=False)
+    model = BertModel(cfg)
+    rng = np.random.RandomState(_SALT)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    types = jnp.zeros((B, S), jnp.int32)
+    mask = jnp.ones((B, S), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)["params"]
+
+    def loss(p, key):
+        x, pooled = model.apply({"params": p}, ids, types, mask,
+                                deterministic=False,
+                                rngs={"dropout": key})
+        return jnp.sum(x.astype(jnp.float32) ** 2) * 1e-6
+
+    @jax.jit
+    def step(p, key):
+        key, sub = jax.random.split(key)
+        g = jax.grad(loss)(p, sub)
+        # bounded but bf16/f32-visible update: keeps inputs fresh for
+        # the memoizer without blowing up over the timing loop
+        p2 = jax.tree.map(
+            lambda a, b: 0.9995 * a - 1e-4 * jnp.tanh(b.astype(jnp.float32)
+                                                      ).astype(a.dtype),
+            p, g)
+        return p2, key
+
+    dt = _chain(step, (params, jax.random.PRNGKey(_SALT)))
+    enc_params = sum(x.size for x in jax.tree.leaves(params))
+    flops = 6.0 * enc_params * B * S + 12.0 * L * B * S * S * H
+    print(f"encoder-only fwd+bwd (dropout .1):  {dt*1e3:7.2f} ms  "
+          f"({flops/dt/1e12:5.1f} TFLOP/s, {flops/dt/PEAK:.3f} MFU, "
+          f"{enc_params/1e6:.0f}M params)")
+    return dt
+
+
+def prof_tail():
+    """MLM head + loss tail alone: transform -> gelu -> LN -> decoder ->
+    logsumexp loss (+ NSP head), fwd+bwd from a (B, S, H) activation."""
+    from apex_tpu.models.bert import pretraining_loss
+    from apex_tpu.normalization import FusedLayerNorm
+    import flax.linen as nn
+
+    rng = np.random.RandomState(_SALT)
+    x = jnp.asarray(rng.randn(B, S, H).astype("f4") * 0.1)  # f32 carry
+    labels = jnp.asarray(
+        np.where(rng.rand(B, S) < 0.15, rng.randint(0, V, (B, S)), -1))
+    nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+
+    class Tail(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(H, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                         name="mlm_transform")(x)
+            h = nn.gelu(h)
+            h = FusedLayerNorm(H, name="mlm_ln")(h)
+            mlm = nn.Dense(V, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                           name="mlm_decoder")(h)
+            nspl = nn.Dense(2, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                            name="nsp")(x[:, 0])
+            return mlm, nspl
+
+    tail = Tail()
+    params = tail.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p, x):
+        mlm, nspl = tail.apply({"params": p}, x.astype(jnp.bfloat16))
+        return pretraining_loss(mlm, nspl, labels, nsp)
+
+    @jax.jit
+    def step(p, x):
+        l, (g, gx) = jax.value_and_grad(loss, argnums=(0, 1))(p, x)
+        p2 = jax.tree.map(
+            lambda a, b: 0.9995 * a - 1e-4 * jnp.tanh(b.astype(jnp.float32)
+                                                      ).astype(a.dtype),
+            p, g)
+        return p2, 0.999 * x - 1e-3 * jnp.tanh(gx)
+
+    dt = _chain(step, (params, x))
+    flops = 6.0 * (H * V + H * H) * B * S
+    print(f"MLM tail fwd+bwd:                   {dt*1e3:7.2f} ms  "
+          f"(matmul-ideal {flops/PEAK*1e3:.1f} ms)")
+    return dt
+
+
+def prof_matmul():
+    """Matmul-chain ceiling at the encoder shape."""
+    a = jax.random.normal(jax.random.PRNGKey(_SALT), (B * S, H),
+                          jnp.bfloat16)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (H, I), jnp.bfloat16)
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (I, H), jnp.bfloat16)
+
+    @jax.jit
+    def step(a):
+        # all-bf16 chain (no fp32 intermediate stores). Normalize by RMS
+        # instead of a fixed 0.01 scale: the fixed scale decays the carry
+        # to exact zeros in a few steps, after which every call has
+        # IDENTICAL inputs and the runtime memoizer serves it instantly
+        # (observed: negative marginal times).
+        for _ in range(8):
+            a = jax.lax.dot(jax.lax.dot(a, w1), w2)
+            a = (a * jax.lax.rsqrt(jnp.mean(a.astype(jnp.float32) ** 2)
+                                   + 1e-6).astype(a.dtype))
+        return (a,)
+
+    dt = _chain(step, (a,), iters=8)
+    flops = 8 * 2 * 2.0 * B * S * H * I
+    print(f"matmul chain ceiling:               {dt*1e3:7.2f} ms  "
+          f"({flops/dt/1e12:5.1f} TFLOP/s = {flops/dt/PEAK:.2f} of peak)")
+    return dt
+
+
+def prof_step():
+    """Full headline step via bench._measure (same session)."""
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    dt, _, mfu = bench._measure(B, S, iters=8, with_baseline=False,
+                                remat=False)
+    return dt
+
+
+COMPONENTS = {"attn": prof_attention, "encoder": prof_encoder,
+              "tail": prof_tail, "matmul": prof_matmul, "step": prof_step}
+
+
+def main():
+    want = [a for a in sys.argv[1:] if a in COMPONENTS] or list(COMPONENTS)
+    for name in want:
+        _reset()
+        COMPONENTS[name]()
+        _reset()
+
+
+if __name__ == "__main__":
+    main()
