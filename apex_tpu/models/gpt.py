@@ -143,13 +143,13 @@ class GPTBlock(nn.Module):
         hd = h // nh
         B, S = x.shape[0], x.shape[1]
 
-        # pre-LN attention
+        # pre-LN attention: three flat (B, S, H) projections shared by
+        # every backend (one param layout — checkpoints stay portable
+        # between flash / ring / Ulysses / composed configs)
         y = _norm(cfg, "ln_1")(x)
-        qkv = _dense(cfg, 3 * h, "attn_qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        q = _dense(cfg, h, "attn_q")(y)
+        k = _dense(cfg, h, "attn_k")(y)
+        v = _dense(cfg, h, "attn_v")(y)
 
         attn_drop = 0.0 if deterministic else cfg.dropout
         # Ulysses ranks share local head indices for different global
@@ -157,9 +157,22 @@ class GPTBlock(nn.Module):
         # ring ranks share the base seed and decorrelate via the global
         # block-pair hash inside ring_attention
         seed = (_dropout_seed(self, False) if attn_drop > 0.0 else None)
-        ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
-                             1.0 / (hd ** 0.5), attn_drop, seed)
-        ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, S, h)
+        if cfg.attention_backend == "flash" and cfg.fused_kernels:
+            from apex_tpu.ops.flash_attention import flash_attention_bsh
+
+            # transpose-free (B, S, H) kernels in the single-tile
+            # regime; falls back to the transposed entry beyond it
+            ctx = flash_attention_bsh(q, k, v, None, nh, True,
+                                      1.0 / (hd ** 0.5), attn_drop,
+                                      seed).astype(cfg.dtype)
+        else:
+            def heads(t):
+                return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+
+            ctx = _causal_attend(cfg, heads(q), heads(k), heads(v),
+                                 1.0 / (hd ** 0.5), attn_drop, seed)
+            ctx = ctx.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(
+                B, S, h)
         attn = _dense(cfg, h, "attn_out")(ctx)
         ctx_axes = _ctx_fold_axes(cfg)
         attn = _TPDropout(cfg.dropout, fused=cfg.fused_kernels,

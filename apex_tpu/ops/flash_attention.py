@@ -931,22 +931,42 @@ flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 # the transposed entry transparently.
 
 
+def _bsh_hpb(NH, D):
+    """Heads per block for the bsh kernels: the widest of {4, 2, 1}
+    whose lane block (hpb*D) is a 128 multiple and divides NH. 0 means
+    the layout can't be block-sliced (fallback to the transposed entry).
+    hpb=2 at D=64 is the Mosaic-minimum 128-lane block; hpb=4 was A/B'd
+    at the headline as an alternative (fewer grid steps, more VMEM per
+    step)."""
+    import os
+
+    forced = os.environ.get("APEX_BSH_HPB")
+    try:
+        cand = ((int(forced),) if forced else (4, 2, 1))
+    except ValueError:
+        cand = (4, 2, 1)
+    for h in cand:
+        if h > 0 and NH % h == 0 and (h * D) % 128 == 0:
+            return h
+    return 0  # no valid grouping: caller falls back to transposed entry
+
+
 def _fwd_single_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, *rest, scale,
-                           causal, bq, bk, NH, D, dropout_rate=0.0,
-                           native_prng=True):
-    """Head-pair single-tile forward on (B, S, NH*D)-layout refs: the
-    (1, bq, 2D) blocks hold heads 2h and 2h+1; same math as
-    _fwd_single_kernel per head."""
+                           causal, bq, bk, NH, D, hpb,
+                           dropout_rate=0.0, native_prng=True):
+    """Head-group single-tile forward on (B, S, NH*D)-layout refs: the
+    (1, bq, hpb*D) blocks hold heads hp*hpb .. hp*hpb+hpb-1; same math
+    as _fwd_single_kernel per head."""
     if dropout_rate > 0.0:
         drop_ref, o_ref, lse_ref = rest
     else:
         drop_ref, (o_ref, lse_ref) = None, rest
     b, hp = pl.program_id(0), pl.program_id(1)
     mrow = mask_ref[0, 0][None, :]
-    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]       # (bq, 2D)
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]       # (bq, hpb*D)
     prec = _prec(q2.dtype)
     outs = []
-    for j in (0, 1):
+    for j in range(hpb):
         q = q2[:, j * D:(j + 1) * D]
         k = k2[:, j * D:(j + 1) * D]
         s = _dot(q, k, ((1,), (1,)), prec) * scale
@@ -960,9 +980,9 @@ def _fwd_single_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, *rest, scale,
         p = jnp.where(mrow >= 2, 0.0, p)
         l = jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
-            # per-HEAD tile id (2*hp + j): identical mask stream to the
-            # transposed entry at the same (b, h) coordinates
-            tid = _tile_id(b, 2 * hp + j, 0, 0, NH, 1, 1)
+            # per-HEAD tile id (hpb*hp + j): identical mask stream to
+            # the transposed entry at the same (b, h) coordinates
+            tid = _tile_id(b, hpb * hp + j, 0, 0, NH, 1, 1)
             keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate,
                               native_prng, interp_idx=(0, j))
             p_av = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
@@ -973,15 +993,15 @@ def _fwd_single_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, *rest, scale,
         safe_l = jnp.where(l > 0, l, 1.0)
         outs.append((pv / safe_l).astype(o_ref.dtype))
         lse_ref[0, j, 0] = (m + jnp.log(safe_l))[:, 0]
-    o_ref[0] = jnp.concatenate(outs, axis=1)
+    o_ref[0] = outs[0] if hpb == 1 else jnp.concatenate(outs, axis=1)
 
 
 def _bwd_fused_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                           delta_ref, *rest, scale, causal, bq, bk, NH, D,
-                          dropout_rate=0.0, native_prng=True):
-    """Head-pair single-tile fused backward on (B, S, NH*D)-layout refs:
-    recomputes s and p once per head and emits dq, dk, dv for the pair
-    (same 5-matmul-per-head economy as _bwd_fused_kernel)."""
+                          hpb, dropout_rate=0.0, native_prng=True):
+    """Head-group single-tile fused backward on (B, S, NH*D)-layout
+    refs: recomputes s and p once per head and emits dq, dk, dv for the
+    group (same 5-matmul-per-head economy as _bwd_fused_kernel)."""
     if dropout_rate > 0.0:
         drop_ref, dq_ref, dk_ref, dv_ref = rest
     else:
@@ -991,7 +1011,7 @@ def _bwd_fused_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
     q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     prec = _prec(q2.dtype)
     dqs, dks, dvs = [], [], []
-    for j in (0, 1):
+    for j in range(hpb):
         q = q2[:, j * D:(j + 1) * D]
         k = k2[:, j * D:(j + 1) * D]
         s = _dot(q, k, ((1,), (1,)), prec) * scale
@@ -1007,7 +1027,7 @@ def _bwd_fused_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         v = v2[:, j * D:(j + 1) * D]
         dp = _dot(do, v, ((1,), (1,)), prec)
         if dropout_rate > 0.0:
-            tid = _tile_id(b, 2 * hp + j, 0, 0, NH, 1, 1)
+            tid = _tile_id(b, hpb * hp + j, 0, 0, NH, 1, 1)
             keep = _keep_mask(drop_ref, tid, bq, bk, dropout_rate,
                               native_prng, interp_idx=(0, j))
             inv_keep = 1.0 / (1.0 - dropout_rate)
@@ -1023,47 +1043,51 @@ def _bwd_fused_kernel_bsh(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                         prec).astype(dq_ref.dtype))
         dks.append(_dot(ds.astype(q.dtype), q, ((0,), (0,)),
                         prec).astype(dk_ref.dtype))
-    dq_ref[0] = jnp.concatenate(dqs, axis=1)
-    dk_ref[0] = jnp.concatenate(dks, axis=1)
-    dv_ref[0] = jnp.concatenate(dvs, axis=1)
+    if hpb == 1:
+        dq_ref[0], dk_ref[0], dv_ref[0] = dqs[0], dks[0], dvs[0]
+    else:
+        dq_ref[0] = jnp.concatenate(dqs, axis=1)
+        dk_ref[0] = jnp.concatenate(dks, axis=1)
+        dv_ref[0] = jnp.concatenate(dvs, axis=1)
 
 
 def _bsh_spec(bs, D2):
-    """BlockSpec slicing head pair hp of a (B, S_padded, NH*D) tensor
-    (lane block 2D, a 128 multiple)."""
+    """BlockSpec slicing head group hp of a (B, S_padded, NH*D) tensor
+    (lane block hpb*D, a 128 multiple)."""
     return pl.BlockSpec((1, bs, D2), lambda b, hp: (b, 0, hp))
 
 
-def _bsh_drop_arg(drop_in, bq, bk):
-    """Dropout input for the pair kernels: scalar seed (native) or the
-    (B, NH, Sqp, Skp) bits tensor blocked (1, 2, bq, bk) per pair."""
+def _bsh_drop_arg(drop_in, bq, bk, hpb):
+    """Dropout input for the group kernels: scalar seed (native) or the
+    (B, NH, Sqp, Skp) bits tensor blocked (1, hpb, bq, bk) per group."""
     if drop_in is None:
         return [], []
     if drop_in.ndim == 1:
         return [drop_in], [pl.BlockSpec(memory_space=pltpu.SMEM)]
-    return [drop_in], [pl.BlockSpec((1, 2, bq, bk),
+    return [drop_in], [pl.BlockSpec((1, hpb, bq, bk),
                                     lambda b, hp: (b, hp, 0, 0))]
 
 
 def _flash_fwd_call_bsh(q, k, v, mask, *, scale, causal, bq, bk, NH, D,
-                        dropout_rate=0.0, drop_in=None):
+                        hpb, dropout_rate=0.0, drop_in=None):
     B, Sp, _ = q.shape
     native = drop_in is not None and drop_in.ndim == 1
-    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk)
+    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk, hpb)
     return pl.pallas_call(
         functools.partial(_fwd_single_kernel_bsh, scale=scale,
                           causal=causal, bq=bq, bk=bk, NH=NH, D=D,
-                          dropout_rate=dropout_rate, native_prng=native),
-        grid=(B, NH // 2),
+                          hpb=hpb, dropout_rate=dropout_rate,
+                          native_prng=native),
+        grid=(B, NH // hpb),
         in_specs=[
-            _bsh_spec(bq, 2 * D),
-            _bsh_spec(bk, 2 * D),
-            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bq, hpb * D),
+            _bsh_spec(bk, hpb * D),
+            _bsh_spec(bk, hpb * D),
             pl.BlockSpec((1, 1, bk), lambda b, hp: (b, 0, 0)),
         ] + extra_specs,
         out_specs=(
-            _bsh_spec(bq, 2 * D),
-            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+            _bsh_spec(bq, hpb * D),
+            pl.BlockSpec((1, hpb, 1, bq), lambda b, hp: (b, hp, 0, 0)),
         ),
         out_shape=(
             out_struct((B, Sp, NH * D), q.dtype, q, k, v),
@@ -1074,28 +1098,30 @@ def _flash_fwd_call_bsh(q, k, v, mask, *, scale, causal, bq, bk, NH, D,
 
 
 def _flash_bwd_call_bsh(q, k, v, mask, do, lse, delta, *, scale, causal,
-                        bq, bk, NH, D, dropout_rate=0.0, drop_in=None):
+                        bq, bk, NH, D, hpb, dropout_rate=0.0,
+                        drop_in=None):
     B, Sp, _ = q.shape
     native = drop_in is not None and drop_in.ndim == 1
-    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk)
+    extra, extra_specs = _bsh_drop_arg(drop_in, bq, bk, hpb)
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel_bsh, scale=scale,
                           causal=causal, bq=bq, bk=bk, NH=NH, D=D,
-                          dropout_rate=dropout_rate, native_prng=native),
-        grid=(B, NH // 2),
+                          hpb=hpb, dropout_rate=dropout_rate,
+                          native_prng=native),
+        grid=(B, NH // hpb),
         in_specs=[
-            _bsh_spec(bq, 2 * D),
-            _bsh_spec(bk, 2 * D),
-            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bq, hpb * D),
+            _bsh_spec(bk, hpb * D),
+            _bsh_spec(bk, hpb * D),
             pl.BlockSpec((1, 1, bk), lambda b, hp: (b, 0, 0)),
-            _bsh_spec(bq, 2 * D),
-            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
-            pl.BlockSpec((1, 2, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+            _bsh_spec(bq, hpb * D),
+            pl.BlockSpec((1, hpb, 1, bq), lambda b, hp: (b, hp, 0, 0)),
+            pl.BlockSpec((1, hpb, 1, bq), lambda b, hp: (b, hp, 0, 0)),
         ] + extra_specs,
         out_specs=(
-            _bsh_spec(bq, 2 * D),
-            _bsh_spec(bk, 2 * D),
-            _bsh_spec(bk, 2 * D),
+            _bsh_spec(bq, hpb * D),
+            _bsh_spec(bk, hpb * D),
+            _bsh_spec(bk, hpb * D),
         ),
         out_shape=(
             out_struct((B, Sp, NH * D), q.dtype, q, k, v, do),
@@ -1107,12 +1133,11 @@ def _flash_bwd_call_bsh(q, k, v, mask, do, lse, delta, *, scale, causal,
 
 
 def _bsh_kernel_ok(S, H, num_heads):
-    """Static gate for the bsh kernel path: head pairs must tile the
+    """Static gate for the bsh kernel path: a head group must tile the
     128-lane block exactly, and the single-tile regime must hold."""
     if H % num_heads:
         return False
-    D = H // num_heads
-    if num_heads % 2 or (2 * D) % 128:
+    if _bsh_hpb(num_heads, H // num_heads) == 0:
         return False
     bq = _block_dim(S)
     return _round_up(S, bq) == bq  # single tile after padding
@@ -1199,6 +1224,7 @@ def _bsh_fwd_impl(q, k, v, key_mask, num_heads, causal, scale,
     out, lse = _flash_fwd_call_bsh(qp, kp, vp, mask, scale=scale,
                                    causal=causal, bq=bq, bk=bk,
                                    NH=num_heads, D=D,
+                                   hpb=_bsh_hpb(num_heads, D),
                                    dropout_rate=dropout_rate,
                                    drop_in=drop_in)
     return out[:, :S], lse
@@ -1229,6 +1255,7 @@ def _bsh_vjp_bwd(num_heads, causal, scale, dropout_rate, res, g):
     dq, dk, dv = _flash_bwd_call_bsh(qp, kp, vp, mask, gp, lse, delta,
                                      scale=scale, causal=causal, bq=bq,
                                      bk=bk, NH=num_heads, D=D,
+                                     hpb=_bsh_hpb(num_heads, D),
                                      dropout_rate=dropout_rate,
                                      drop_in=drop_in)
     return (match_vma(dq[:, :S].astype(q.dtype), q),

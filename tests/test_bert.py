@@ -91,3 +91,52 @@ def test_dropout_rng_and_determinism():
                         rngs={"dropout": jax.random.PRNGKey(2)})
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
     assert not np.allclose(np.asarray(a1), np.asarray(a3))
+
+
+def test_gathered_mlm_head_matches_full_sequence_loss():
+    """MLPerf gathered-predictions head (masked_positions): running the
+    MLM transform+decoder only on the gathered positions must give the
+    SAME pretraining loss as the full-sequence head with -1-ignored
+    labels at the same positions (round-4 tail optimization)."""
+    from apex_tpu.models import pretraining_loss
+
+    cfg = BertConfig.tiny()
+    model = BertForPreTraining(cfg)
+    ids, types, mask, _, _ = _batch(cfg)
+    B, S = ids.shape
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)
+
+    rng = np.random.RandomState(5)
+    P = 4
+    pos = np.stack([np.sort(rng.choice(S, P, replace=False))
+                    for _ in range(B)])
+    lab = rng.randint(0, cfg.vocab_size, (B, P))
+    # full-sequence labels: -1 everywhere except the chosen positions
+    full_lab = np.full((B, S), -1, np.int64)
+    for b in range(B):
+        full_lab[b, pos[b]] = lab[b]
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (B,)))
+
+    mlm_full, nsp = model.apply(params, ids, types, mask)
+    loss_full = pretraining_loss(mlm_full, nsp, jnp.asarray(full_lab),
+                                 nsp_labels)
+
+    mlm_g, nsp_g = model.apply(params, ids, types, mask,
+                               masked_positions=jnp.asarray(pos))
+    assert mlm_g.shape == (B, P, cfg.vocab_size)
+    loss_g = pretraining_loss(mlm_g, nsp_g, jnp.asarray(lab), nsp_labels,
+                              jnp.ones((B, P), jnp.float32))
+    np.testing.assert_allclose(float(loss_g), float(loss_full),
+                               rtol=1e-5, atol=1e-6)
+
+    # padding slots (weight 0) must not change the loss
+    pos_pad = np.concatenate([pos, np.zeros((B, 2), np.int64)], axis=1)
+    lab_pad = np.concatenate([lab, np.zeros((B, 2), np.int64)], axis=1)
+    w_pad = np.concatenate([np.ones((B, P), np.float32),
+                            np.zeros((B, 2), np.float32)], axis=1)
+    mlm_p, nsp_p = model.apply(params, ids, types, mask,
+                               masked_positions=jnp.asarray(pos_pad))
+    loss_p = pretraining_loss(mlm_p, nsp_p, jnp.asarray(lab_pad),
+                              nsp_labels, jnp.asarray(w_pad))
+    np.testing.assert_allclose(float(loss_p), float(loss_full),
+                               rtol=1e-5, atol=1e-6)

@@ -213,8 +213,43 @@ def prof_step():
     return dt
 
 
+def prof_embed():
+    """BertEmbeddings fwd+bwd alone: vocab gather + pos/type add + LN +
+    dropout forward; the backward's cost center is the scatter-add of
+    (B*S, H) token grads into the (30522, H) embedding table."""
+    from apex_tpu.models import BertConfig
+    from apex_tpu.models.bert import BertEmbeddings
+
+    cfg = BertConfig.bert_large(dtype=jnp.bfloat16)
+    emb = BertEmbeddings(cfg)
+    rng = np.random.RandomState(_SALT)
+    ids = jnp.asarray(rng.randint(0, V, (B, S)))
+    types = jnp.zeros((B, S), jnp.int32)
+    params = emb.init(jax.random.PRNGKey(0), ids, types)["params"]
+
+    def loss(p, key):
+        x = emb.apply({"params": p}, ids, types, deterministic=False,
+                      rngs={"dropout": key})
+        return jnp.sum(x.astype(jnp.float32) ** 2) * 1e-6
+
+    @jax.jit
+    def step(p, key):
+        key, sub = jax.random.split(key)
+        g = jax.grad(loss)(p, sub)
+        p2 = jax.tree.map(
+            lambda a, b: 0.9995 * a - 1e-4 * jnp.tanh(b.astype(jnp.float32)
+                                                      ).astype(a.dtype),
+            p, g)
+        return p2, key
+
+    dt = _chain(step, (params, jax.random.PRNGKey(_SALT)))
+    print(f"embeddings fwd+bwd:                 {dt*1e3:7.2f} ms")
+    return dt
+
+
 COMPONENTS = {"attn": prof_attention, "encoder": prof_encoder,
-              "tail": prof_tail, "matmul": prof_matmul, "step": prof_step}
+              "tail": prof_tail, "matmul": prof_matmul,
+              "embed": prof_embed, "step": prof_step}
 
 
 def main():
