@@ -941,10 +941,24 @@ def _bsh_hpb(NH, D):
     import os
 
     forced = os.environ.get("APEX_BSH_HPB")
-    try:
-        cand = ((int(forced),) if forced else (4, 2, 1))
-    except ValueError:
-        cand = (4, 2, 1)
+    cand = (4, 2, 1)
+    if forced:
+        try:
+            cand = (int(forced),)
+        except ValueError:
+            cand = ()
+        if not any(h > 0 and NH % h == 0 and (h * D) % 128 == 0
+                   for h in cand):
+            # an unusable forced value must NOT silently divert to the
+            # transposed entry — the A/B the env var exists for would
+            # record the wrong code path; warn and use the default sweep
+            import warnings
+
+            warnings.warn(
+                f"APEX_BSH_HPB={forced!r} is not a valid head grouping "
+                f"for NH={NH}, D={D}; using the default (4, 2, 1) sweep "
+                f"instead", stacklevel=3)
+            cand = (4, 2, 1)
     for h in cand:
         if h > 0 and NH % h == 0 and (h * D) % 128 == 0:
             return h

@@ -626,12 +626,12 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     # Headline: the BASELINE seq-512-class pretraining shape. With the
     # logsumexp MLM loss, B=16 WITHOUT per-layer remat fits the 16 GB
-    # chip and beats every remat'd batch (no recompute tax: 73.5 vs
-    # 67.6 samples/s at B=32 remat'd). Re-swept on-chip this round:
-    # B=20 no-remat drops to 69.1 samples/s (MFU .423) and B>=24 OOMs
-    # at any remat policy (incl. dots-only), so B=16 stays the peak.
-    # The fp32 baseline keeps remat (its fp32 activations would not
-    # fit otherwise).
+    # chip and beats every remat'd batch (no recompute tax). Round-4
+    # re-sweep (marginal timing, same session): B=20 no-remat now TIES
+    # B=16 (107.7 vs 105.4 samples/s — round 3 had it 7% behind);
+    # B=16 stays the recorded config for memory headroom. B>=24 OOMs
+    # at any remat policy. The fp32 baseline keeps remat (its fp32
+    # activations would not fit otherwise).
     batch, seq = (16, 512) if on_tpu else (2, 32)
     dt_opt, dt_base, mfu = _measure(batch, seq, iters=8, remat=not on_tpu)
     if on_tpu and "--all-shapes" in sys.argv:

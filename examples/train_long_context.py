@@ -51,7 +51,7 @@ def build_model(vocab, hidden, heads, axis):
             "unembed": jax.random.normal(ks[7], (hidden, vocab)) * s,
         }
 
-    def block(x, qkv_w, out_w):
+    def block(x, qkv_w, out_w, drop_seed):
         B, S_local, _ = x.shape
         qkv = x @ qkv_w
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -59,16 +59,21 @@ def build_model(vocab, hidden, heads, axis):
         def heads_of(t):
             return t.reshape(B, S_local, heads, hd).transpose(0, 2, 1, 3)
 
+        # TRUE training config: attention-probability dropout 0.1 fused
+        # into the per-block flash kernels (round 4 — the ring derives
+        # per-(q-block, kv-block) seeds from drop_seed internally, so
+        # the lse merge stays exact and backward replays the masks)
         ctx = ring_attention(heads_of(q), heads_of(k), heads_of(v),
-                             None, True, 1.0 / np.sqrt(hd), axis_name=axis)
+                             None, True, 1.0 / np.sqrt(hd), axis_name=axis,
+                             dropout_rate=0.1, dropout_seed=drop_seed)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S_local, -1)
         return x + ctx @ out_w
 
-    def loss_fn(params, ids):
+    def loss_fn(params, ids, step_idx):
         x = params["embed"][ids]                     # (B, S_local, H)
-        x = block(x, params["qkv0"], params["out0"])
+        x = block(x, params["qkv0"], params["out0"], 2 * step_idx)
         x = x + jax.nn.gelu(x @ params["mlp0a"]) @ params["mlp0b"]
-        x = block(x, params["qkv1"], params["out1"])
+        x = block(x, params["qkv1"], params["out1"], 2 * step_idx + 1)
         logits = x @ params["unembed"]
         # next-token prediction within the shard (boundary token dropped
         # for simplicity; a production loader overlaps shards by 1)
@@ -109,19 +114,21 @@ def main():
     ids = jnp.asarray(rng.randint(0, args.vocab,
                                   (args.batch_size, args.seq)))
 
-    def step(params, opt_state, ids_local):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids_local)
+    def step(params, opt_state, ids_local, step_idx):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids_local,
+                                                  step_idx)
         # grads of replicated params are already psummed by shard_map AD
         params, opt_state = opt.step(grads, opt_state, params)
         return params, opt_state, loss
 
     stepped = jax.jit(jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P(), P(None, "context")),
+        step, mesh=mesh, in_specs=(P(), P(), P(None, "context"), P()),
         out_specs=(P(), P(), P())))
 
     t0 = time.perf_counter()
     for i in range(args.steps):
-        params, opt_state, loss = stepped(params, opt_state, ids)
+        params, opt_state, loss = stepped(params, opt_state, ids,
+                                          jnp.int32(i))
         if i == 0:
             jax.block_until_ready(loss)
             t0 = time.perf_counter()

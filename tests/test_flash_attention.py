@@ -245,3 +245,89 @@ def test_dropout_requires_seed():
     with pytest.raises(ValueError, match="dropout_seed"):
         jax.jit(lambda q, k, v: flash_attention(
             q, k, v, None, False, 1.0, 0.1, None))(q, k, v)
+
+
+# ------------------------------------------------ (B, S, NH*D) bsh entry
+
+def _bsh_ref(q, k, v, NH, causal, scale, rate=0.0, seed=None, km=None):
+    """Transposed-entry reference for the flat layout."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    B, S, H = q.shape
+    D = H // NH
+
+    def split(t):
+        return t.reshape(B, S, NH, D).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split(q), split(k), split(v), km, causal, scale,
+                          rate, seed)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, H)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("rate,seed", [(0.0, None), (0.1, 42)])
+def test_bsh_entry_matches_transposed(causal, rate, seed):
+    """flash_attention_bsh (head-group kernels on flat activations) is
+    bitwise the transposed entry — outputs AND gradients, with and
+    without fused dropout (identical per-head PRNG tile ids)."""
+    from apex_tpu.ops.flash_attention import flash_attention_bsh
+
+    B, S, NH, D = 2, 128, 4, 64
+    H = NH * D
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H), jnp.float32) for kk in ks)
+    out = flash_attention_bsh(q, k, v, None, NH, causal, 0.125, rate, seed)
+    ref = _bsh_ref(q, k, v, NH, causal, 0.125, rate, seed)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(jnp.sin(f(q, k, v)))
+
+    g = jax.grad(loss(lambda a, b, c: flash_attention_bsh(
+        a, b, c, None, NH, causal, 0.125, rate, seed)), argnums=(0, 1, 2))(
+        q, k, v)
+    gr = jax.grad(loss(lambda a, b, c: _bsh_ref(
+        a, b, c, NH, causal, 0.125, rate, seed)), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bsh_entry_unaligned_seq_and_mask():
+    from apex_tpu.ops.flash_attention import flash_attention_bsh
+
+    B, S, NH, D = 2, 100, 4, 64  # S pads 100 -> 128 in-entry
+    H = NH * D
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H), jnp.float32) for kk in ks)
+    km = jnp.asarray(np.random.RandomState(2).rand(B, S) < 0.2)
+    out = flash_attention_bsh(q, k, v, km, NH, False, 0.125)
+    ref = _bsh_ref(q, k, v, NH, False, 0.125, km=km)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_bsh_entry_fallback_paths():
+    """Configs the head-group kernels can't take (odd NH at D=64, or a
+    multi-tile sequence) must transparently fall back to the transposed
+    entry with identical semantics."""
+    from apex_tpu.ops.flash_attention import flash_attention_bsh
+
+    # odd NH=3 at D=64: no valid 128-lane grouping
+    B, S, NH, D = 1, 128, 3, 64
+    H = NH * D
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H), jnp.float32) for kk in ks)
+    out = flash_attention_bsh(q, k, v, None, NH, False, 0.125)
+    ref = _bsh_ref(q, k, v, NH, False, 0.125)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+    # S=640: beyond the single-tile regime
+    B, S, NH, D = 1, 640, 4, 64
+    H = NH * D
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H), jnp.float32) for kk in ks)
+    out = flash_attention_bsh(q, k, v, None, NH, True, 0.125, 0.1, 7)
+    ref = _bsh_ref(q, k, v, NH, True, 0.125, 0.1, 7)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
