@@ -170,12 +170,15 @@ def ring_attention(q, k, v, key_mask=None, causal: bool = False,
 
     # the running accumulators become device-varying from step 1 on
     # (they mix in ppermuted blocks); mark the init to keep the scan
-    # carry type stable under shard_map's vma checking
+    # carry type stable under shard_map's vma checking. k/v must be
+    # marked too: a caller may pass context-INVARIANT tensors (cp=1
+    # mesh, or replicated q/k/v) and the body's ppermute makes the
+    # carry slots varying regardless.
     init = (
         mark_varying(jnp.zeros((B, H, S_local, D), jnp.float32), mark),
         mark_varying(jnp.full((B, H, 1, S_local), -jnp.inf, jnp.float32),
                      mark),
-        k, v, key_mask,
+        mark_varying(k, mark), mark_varying(v, mark), key_mask,
     )
     (acc, lse, _, _, _), _ = jax.lax.scan(tick, init, jnp.arange(cp))
     return acc.astype(q.dtype)

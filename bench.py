@@ -628,10 +628,12 @@ def main():
     # logsumexp MLM loss, B=16 WITHOUT per-layer remat fits the 16 GB
     # chip and beats every remat'd batch (no recompute tax). Round-4
     # re-sweep (marginal timing, same session): B=20 no-remat now TIES
-    # B=16 (107.7 vs 105.4 samples/s — round 3 had it 7% behind);
-    # B=16 stays the recorded config for memory headroom. B>=24 OOMs
-    # at any remat policy. The fp32 baseline keeps remat (its fp32
-    # activations would not fit otherwise).
+    # B=16 (107.7 vs 105.4 samples/s — round 3 had it 7% behind), and
+    # the gathered MLM tail frees enough activation memory that B=24
+    # and B=32 now FIT no-remat — but run SLOWER per sample (99.9 /
+    # 101.9 samples/s). B=16 stays the recorded peak. The fp32
+    # baseline keeps remat (its fp32 activations would not fit
+    # otherwise).
     batch, seq = (16, 512) if on_tpu else (2, 32)
     dt_opt, dt_base, mfu = _measure(batch, seq, iters=8, remat=not on_tpu)
     if on_tpu and "--all-shapes" in sys.argv:

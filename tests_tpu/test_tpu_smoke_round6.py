@@ -119,3 +119,81 @@ def test_flash_bsh_bitwise_matches_transposed_on_chip():
                                         None, False, 0.125, rate, seed)),
         q)))(q)
     np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_flash_with_lse_dropout_tiled_path_on_chip():
+    """S=640 forces the SPLIT dq/dkv backward (nk=2): fused dropout
+    replay + the lse-cotangent delta fold must compose on the TILED
+    kernels too — the path a long-context ring shard (S_local > 512)
+    takes on real hardware."""
+    from apex_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        flash_dropout_keep_mask,
+    )
+
+    B, H, S, D = 1, 2, 640, 64
+    rate, seed = 0.1, 555
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    keep = flash_dropout_keep_mask(B, H, S, S, rate, seed)
+
+    def loss_fused(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, None, False, 0.125,
+                                            rate, seed)
+        return jnp.sum(jnp.sin(out)) + 0.1 * jnp.sum(jnp.cos(lse))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+        lse = jax.nn.logsumexp(s, axis=-1)[:, :, None, :]
+        p = jnp.exp(s - lse.transpose(0, 1, 3, 2))
+        p = jnp.where(keep, p, 0.0) / (1 - rate)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.sum(jnp.sin(out)) + 0.1 * jnp.sum(jnp.cos(lse))
+
+    with jax.default_matmul_precision("highest"):
+        vf = jax.jit(loss_fused)(q, k, v)
+        vr = jax.jit(loss_ref)(q, k, v)
+        g = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    assert abs(float(vf) - float(vr)) < 1e-3
+    for name, a, b in zip("qkv", g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-4, name
+
+
+def test_ring_attention_dropout_compiled_on_chip():
+    """Ring attention with fused dropout on the real chip (cp=1 ring —
+    the scan/merge/seed-hash code compiled by Mosaic+XLA, single
+    device): matches composed attention with the block's keep-mask."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.ops.flash_attention import (
+        flash_dropout_keep_mask,
+        mha_with_mask_reference,
+    )
+    from apex_tpu.ops.ring_attention import _block_seed, ring_attention
+
+    B, H, S, D = 2, 2, 256, 64
+    rate, seed = 0.1, 321
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    mesh = jax.make_mesh((1,), ("context",))
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, None, False, 0.125,
+                              axis_name="context", dropout_rate=rate,
+                              dropout_seed=seed)
+
+    with jax.default_matmul_precision("highest"):
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=P(None, None, "context")))(q, k, v)
+        keep = flash_dropout_keep_mask(
+            B, H, S, S, rate,
+            _block_seed(seed, jnp.int32(0), jnp.int32(0), 1))
+        ref = mha_with_mask_reference(q, k, v, keep, None, False, 0.125,
+                                      rate)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-4
