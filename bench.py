@@ -196,8 +196,11 @@ def marginal_time(advance, fetch, iters, windows=2):
     return min(marginals)
 
 
-def time_steps(jitted, state_box, warmup=2, iters=8):
-    """Headline-step timing via :func:`marginal_time`."""
+def time_steps(jitted, state_box, warmup=2, iters=8, windows=3):
+    """Headline-step timing via :func:`marginal_time` (best-of-3 window
+    pairs: the headline is the round's recorded number, so it gets one
+    more chance against tunnel-latency spikes than the microbenches;
+    each extra pair costs ~2 s)."""
     params, ost, sst, key = state_box.pop()  # take ownership; see build_step
     loss = None
     for _ in range(warmup):
@@ -209,7 +212,8 @@ def time_steps(jitted, state_box, warmup=2, iters=8):
         for _ in range(n):
             params, ost, sst, loss, key = jitted(params, ost, sst, key)
 
-    dt = marginal_time(advance, lambda: float(loss), iters)
+    dt = marginal_time(advance, lambda: float(loss), iters,
+                       windows=windows)
     return dt, float(loss)
 
 
