@@ -8,7 +8,8 @@ actionable budget (attention kernels / encoder matmuls / MLM tail /
 optimizer) against the 141 TFLOP/s measured matmul ceiling.
 
 Usage:  python tools/profile_step.py [component ...]
-        components: attn encoder tail step matmul (default: all)
+        components: attn encoder tail matmul embed opt step
+        (default: all; `opt` needs a ~10-minute standalone compile)
 """
 
 import os
@@ -247,9 +248,53 @@ def prof_embed():
     return dt
 
 
+def prof_opt():
+    """Full-size FusedLAMB O2 step alone (367M params, fp32 masters +
+    both moments): state traffic is ~11 GB/step, so the bandwidth
+    roofline is ~13 ms — this measures how close the fused update runs
+    to it. NOTE: the 399-leaf compile regularly exceeds 10 minutes
+    through the tunnel and sometimes drops it (retry loop)."""
+    import apex_tpu.amp as amp
+    from apex_tpu.models import BertConfig, BertForPreTraining
+    from apex_tpu.optimizers import FusedLAMB
+
+    cfg = BertConfig.bert_large(dtype=jnp.bfloat16)
+    model = BertForPreTraining(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, None,
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    opt = FusedLAMB(lr=1e-4, weight_decay=0.01)
+    params, opt, handle = amp.initialize(params, opt, opt_level="O2",
+                                         verbosity=0)
+    ost = opt.init(params)
+    grads = jax.tree.map(lambda p: (p * 1e-3).astype(p.dtype), params)
+
+    @jax.jit
+    def step(params, ost, c):
+        p2, ost2, found = opt.step(
+            jax.tree.map(lambda g: g * (1.0 + c * 1e-6), grads), ost,
+            params, grad_scale=jnp.float32(65536.0))
+        return p2, ost2, c + 1.0
+
+    for attempt in range(3):
+        try:
+            # _chain does warmup + fetch before timing, so the huge
+            # compile lands outside every timed window
+            dt = _chain(step,
+                        (params, ost, jnp.float32(_SALT % 1000 + attempt)))
+            print(f"optimizer (FusedLAMB O2 367M):      {dt*1e3:7.2f} ms"
+                  f"  (state-traffic roofline ~13 ms)")
+            return dt
+        except Exception as e:  # tunnel drops on the huge compile are
+            if attempt == 2:    # transient; anything else must surface
+                raise
+            print(f"# prof_opt attempt {attempt}: {e!r}", file=sys.stderr)
+    return None
+
+
 COMPONENTS = {"attn": prof_attention, "encoder": prof_encoder,
               "tail": prof_tail, "matmul": prof_matmul,
-              "embed": prof_embed, "step": prof_step}
+              "embed": prof_embed, "opt": prof_opt, "step": prof_step}
 
 
 def main():
