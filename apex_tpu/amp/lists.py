@@ -15,46 +15,70 @@ same table drives both the patcher and introspection.
 """
 
 # Ops cast to the policy compute dtype (bf16 on TPU): the FLOP carriers
-# that map onto the MXU. Mirrors apex's FP16_FUNCS (conv*, *mm variants,
-# matmul, linear, prelu...).
+# that map onto the MXU. Mirrors apex's FP16_FUNCS (conv1d/2d/3d +
+# transposed variants, the *mm/*mv/bmm matmul family, matmul, linear,
+# ger/outer, prelu — each mapped to its jax carrier; the many torch
+# aliases of one GEMM collapse onto dot_general/einsum here).
 WHITELIST = [
     ("jax.numpy", "matmul"),
     ("jax.numpy", "dot"),
     ("jax.numpy", "vdot"),
     ("jax.numpy", "inner"),
+    ("jax.numpy", "outer"),            # torch ger/addr analog
     ("jax.numpy", "tensordot"),
     ("jax.numpy", "einsum"),
+    ("jax.numpy", "linalg.multi_dot"),  # chained addmm analog
     ("jax.lax", "dot_general"),
     ("jax.lax", "dot"),
-    ("jax.lax", "conv_general_dilated"),
+    ("jax.lax", "conv_general_dilated"),  # conv1d/2d/3d carrier
     ("jax.lax", "conv"),
     ("jax.lax", "conv_with_general_padding"),
+    ("jax.lax", "conv_transpose"),     # conv_transpose1d/2d/3d analog
 ]
 
 # Ops forced to fp32: mirrors apex's FP32_FUNCS (softmax/log_softmax,
-# exp/log/pow family, norms, losses, cumulative reductions).
+# exp/log/pow family, trig/hyperbolic inverses, reciprocal/rsqrt,
+# norms, loss functions, cumulative reductions).
 BLACKLIST = [
     ("jax.numpy", "exp"),
+    ("jax.numpy", "exp2"),
     ("jax.numpy", "expm1"),
     ("jax.numpy", "log"),
     ("jax.numpy", "log1p"),
     ("jax.numpy", "log2"),
     ("jax.numpy", "log10"),
+    ("jax.numpy", "logaddexp"),
+    ("jax.numpy", "logaddexp2"),
     ("jax.numpy", "power"),
     ("jax.numpy", "float_power"),
+    ("jax.numpy", "reciprocal"),
     ("jax.numpy", "cosh"),
     ("jax.numpy", "sinh"),
     ("jax.numpy", "tan"),
+    ("jax.numpy", "arccos"),           # torch acos
+    ("jax.numpy", "arcsin"),           # torch asin
     ("jax.numpy", "cumsum"),
     ("jax.numpy", "cumprod"),
     ("jax.numpy", "prod"),
     ("jax.numpy", "linalg.norm"),
     ("jax.nn", "softmax"),
     ("jax.nn", "log_softmax"),
+    ("jax.nn", "softplus"),
     ("jax.nn", "standardize"),
     ("jax.scipy.special", "logsumexp"),
     ("jax.lax", "rsqrt"),
     ("jax.lax", "erf_inv"),
+    # loss family (apex blacklists the torch.nn.functional losses;
+    # optax is the jax loss surface). BOTH holders are patched: the
+    # top-level alias and the canonical optax.losses module are the
+    # same function object, and a call through the unpatched holder
+    # would silently bypass the fp32 forcing.
+    ("optax", "softmax_cross_entropy"),
+    ("optax", "softmax_cross_entropy_with_integer_labels"),
+    ("optax", "sigmoid_binary_cross_entropy"),
+    ("optax.losses", "softmax_cross_entropy"),
+    ("optax.losses", "softmax_cross_entropy_with_integer_labels"),
+    ("optax.losses", "sigmoid_binary_cross_entropy"),
 ]
 
 # Binary ops whose mixed-dtype behavior apex resolves by promote-to-widest.

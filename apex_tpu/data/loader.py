@@ -172,6 +172,15 @@ class _BaseLoader:
     """Shared epoch/shuffle/prefetch machinery.
 
     corpus: (N, S) int32 array of tokenized sequences (memmap works).
+
+    ``drop_last=False`` (torch-DataLoader parity) keeps the epoch tail
+    when the corpus is not batch-divisible — but with STATIC shapes:
+    the final batch is padded to ``batch_size`` by repeating its last
+    valid row, and every yielded batch gains a trailing
+    ``sample_weights`` float32 (batch,) array (1.0 valid / 0.0 pad) so
+    losses mask the padding without any per-tail recompile. (A
+    torch-style smaller tail batch would change the jit input shape and
+    force an XLA recompile each epoch.)
     """
 
     def __init__(self, corpus, batch_size: int, *, seed: int = 0,
@@ -188,14 +197,30 @@ class _BaseLoader:
         self.drop_last = drop_last
         self.prefetch = int(prefetch)
         self.epoch = 0
-        if not drop_last and len(self.corpus) % batch_size != 0:
-            raise NotImplementedError(
-                "partial final batches produce dynamic shapes, which "
-                "force an XLA recompile per epoch tail; pad the corpus "
-                "or use drop_last=True")
 
     def __len__(self):
-        return len(self.corpus) // self.batch_size
+        n, b = len(self.corpus), self.batch_size
+        return n // b if self.drop_last else -(-n // b)
+
+    def valid_rows(self, b: int) -> int:
+        """Number of non-padding rows in batch ``b`` (== batch_size for
+        all but a ``drop_last=False`` epoch tail)."""
+        if b < 0 or b >= len(self):
+            raise IndexError(f"batch {b} out of range [0, {len(self)})")
+        if b < len(self.corpus) // self.batch_size:
+            return self.batch_size
+        return len(self.corpus) - b * self.batch_size
+
+    def _batch_rows(self, order: np.ndarray, b: int):
+        """(row indices padded to batch_size, sample weights)."""
+        rows = order[b * self.batch_size:(b + 1) * self.batch_size]
+        valid = len(rows)
+        if valid < self.batch_size:  # pad-and-mask the epoch tail
+            rows = np.concatenate(
+                [rows, np.repeat(rows[-1:], self.batch_size - valid)])
+        weights = np.zeros(self.batch_size, np.float32)
+        weights[:valid] = 1.0
+        return rows, weights
 
     def set_epoch(self, epoch: int):
         """Reshuffle for a new epoch (distributed-sampler analog)."""
@@ -220,6 +245,10 @@ class MLMBatchLoader(_BaseLoader):
     """BERT masked-LM batches: yields ``(input_ids, mlm_labels)`` int32
     numpy arrays of shape (batch, seq); labels are -1 on unmasked
     positions (the convention ``models.bert.pretraining_loss`` expects).
+
+    With ``drop_last=False`` every batch is
+    ``(input_ids, mlm_labels, sample_weights)``; padding rows of the
+    epoch tail carry all ``-1`` labels (zero MLM loss) and weight 0.
     """
 
     def __init__(self, corpus, batch_size: int, vocab_size: int,
@@ -232,19 +261,27 @@ class MLMBatchLoader(_BaseLoader):
         self.mask_prob = float(mask_prob)
 
     def _make_batch(self, order: np.ndarray, b: int):
-        rows = order[b * self.batch_size:(b + 1) * self.batch_size]
+        rows, weights = self._batch_rows(order, b)
         tokens = _gather_rows(self.corpus, rows)
         ids, labels = _mlm_mask(
             tokens, self.vocab_size, self.mask_id, self.special_ids,
             self.mask_prob,
             (self.seed << 40) ^ (self.epoch << 20) ^ (b + 1))
-        return ids, labels
+        if self.drop_last:
+            return ids, labels
+        labels[weights == 0.0] = -1  # padding rows: no loss positions
+        return ids, labels, weights
 
 
 class CausalLMBatchLoader(_BaseLoader):
     """GPT-style batches: yields ``input_ids`` (batch, seq) int32; the
-    next-token shift lives in ``models.gpt.lm_loss``."""
+    next-token shift lives in ``models.gpt.lm_loss``. With
+    ``drop_last=False`` every batch is ``(input_ids, sample_weights)``
+    (see :class:`_BaseLoader`)."""
 
     def _make_batch(self, order: np.ndarray, b: int):
-        rows = order[b * self.batch_size:(b + 1) * self.batch_size]
-        return _gather_rows(self.corpus, rows)
+        rows, weights = self._batch_rows(order, b)
+        ids = _gather_rows(self.corpus, rows)
+        if self.drop_last:
+            return ids
+        return ids, weights

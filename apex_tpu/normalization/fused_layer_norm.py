@@ -24,20 +24,42 @@ from apex_tpu.ops.layer_norm import (
 )
 
 
-def _last_dim(normalized_shape) -> int:
+def _norm_shape(normalized_shape) -> tuple:
+    """Normalized-shape tuple (apex accepts an int or a trailing-dims
+    tuple; multi-dim shapes normalize over ALL the trailing dims)."""
     if isinstance(normalized_shape, int):
-        return normalized_shape
-    shape = tuple(normalized_shape)
-    if len(shape) != 1:
-        raise NotImplementedError(
-            "apex_tpu norms fuse over the last dimension; multi-dim "
-            "normalized_shape should be reshaped by the caller."
-        )
-    return shape[0]
+        return (normalized_shape,)
+    return tuple(int(d) for d in normalized_shape)
+
+
+def _check_trailing(x, shape):
+    k = len(shape)
+    if tuple(x.shape[-k:]) != shape:
+        raise ValueError(
+            f"expected trailing dims {shape}, got {tuple(x.shape[-k:])}")
+
+
+def _flatten_trailing(x, shape):
+    """Collapse the trailing ``len(shape)`` dims into one (the fused
+    kernels normalize over the last axis; a multi-dim normalized_shape
+    is the same computation on the flattened view)."""
+    k = len(shape)
+    if k == 1:
+        return x, x.shape
+    lead = x.shape[:-k]
+    n = 1
+    for d in shape:
+        n *= d
+    return x.reshape(*lead, n), x.shape
 
 
 class FusedLayerNorm(nn.Module):
-    """Reference: ``apex.normalization.FusedLayerNorm``."""
+    """Reference: ``apex.normalization.FusedLayerNorm``.
+
+    ``normalized_shape`` may be an int or a tuple of trailing dims
+    (apex parity): multi-dim shapes normalize over all the trailing
+    dims via a flattened view, and affine params keep the full
+    ``normalized_shape`` shape so checkpoints match apex's layout."""
 
     normalized_shape: Union[int, Sequence[int]]
     eps: float = 1e-5
@@ -47,18 +69,24 @@ class FusedLayerNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = _last_dim(self.normalized_shape)
-        if x.shape[-1] != h:
-            raise ValueError(f"expected trailing dim {h}, got {x.shape[-1]}")
+        shape = _norm_shape(self.normalized_shape)
+        _check_trailing(x, shape)
+        x2, orig = _flatten_trailing(x, shape)
+        h = x2.shape[-1]
         if not self.elementwise_affine:
-            return fused_layer_norm(x, h, self.eps)
-        weight = self.param("scale", nn.initializers.ones, (h,), self.param_dtype)
-        bias = self.param("bias", nn.initializers.zeros, (h,), self.param_dtype)
-        return fused_layer_norm_affine(x, weight, bias, self.eps, self.memory_efficient)
+            return fused_layer_norm(x2, h, self.eps).reshape(orig)
+        weight = self.param("scale", nn.initializers.ones, shape,
+                            self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, shape,
+                          self.param_dtype)
+        y = fused_layer_norm_affine(x2, weight.reshape(h), bias.reshape(h),
+                                    self.eps, self.memory_efficient)
+        return y.reshape(orig)
 
 
 class FusedRMSNorm(nn.Module):
-    """Reference: ``apex.normalization.FusedRMSNorm``."""
+    """Reference: ``apex.normalization.FusedRMSNorm``. Accepts int or
+    multi-dim ``normalized_shape`` like :class:`FusedLayerNorm`."""
 
     normalized_shape: Union[int, Sequence[int]]
     eps: float = 1e-5
@@ -68,13 +96,17 @@ class FusedRMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = _last_dim(self.normalized_shape)
-        if x.shape[-1] != h:
-            raise ValueError(f"expected trailing dim {h}, got {x.shape[-1]}")
+        shape = _norm_shape(self.normalized_shape)
+        _check_trailing(x, shape)
+        x2, orig = _flatten_trailing(x, shape)
+        h = x2.shape[-1]
         if not self.elementwise_affine:
-            return fused_rms_norm(x, h, self.eps)
-        weight = self.param("scale", nn.initializers.ones, (h,), self.param_dtype)
-        return fused_rms_norm_affine(x, weight, self.eps, self.memory_efficient)
+            return fused_rms_norm(x2, h, self.eps).reshape(orig)
+        weight = self.param("scale", nn.initializers.ones, shape,
+                            self.param_dtype)
+        y = fused_rms_norm_affine(x2, weight.reshape(h), self.eps,
+                                  self.memory_efficient)
+        return y.reshape(orig)
 
 
 class MixedFusedLayerNorm(FusedLayerNorm):

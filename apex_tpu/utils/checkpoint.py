@@ -87,3 +87,89 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
         item["_step"] = step
         return _checkpointer().restore(path, item=item)
     return _checkpointer().restore(path)
+
+
+# ---------------------------------------------------------------------------
+# fused-qkv <-> split-q/k/v checkpoint remapping
+# ---------------------------------------------------------------------------
+#
+# The TP attention blocks keep ONE fused qkv projection (Megatron layout:
+# [q | k | v] along the output axis of a ColumnParallelLinear named
+# "qkv" / "attn_qkv"), while the non-TP blocks use three flat q/k/v
+# Dense params (the transpose-free flash-entry layout). Checkpoints are
+# therefore NOT layout-portable between TP and non-TP configs; these
+# helpers convert a param tree between the two layouts so either kind of
+# checkpoint loads into either config.
+
+_QKV_FUSED_NAMES = {"qkv": ("q", "k", "v"),
+                    "attn_qkv": ("attn_q", "attn_k", "attn_v")}
+
+
+def _is_linear_params(v) -> bool:
+    return (isinstance(v, dict) and "kernel" in v
+            and all(k in ("kernel", "bias") for k in v))
+
+
+def split_fused_qkv(params, fused_names=None):
+    """Rewrite every fused ``qkv`` linear in ``params`` into three
+    ``q``/``k``/``v`` linears (split on the last axis, Megatron
+    [q | k | v] order). Non-qkv subtrees pass through untouched; the
+    input tree is not modified. ``fused_names`` maps fused module name →
+    3-tuple of split names (default: ``qkv``→(q,k,v),
+    ``attn_qkv``→(attn_q,attn_k,attn_v))."""
+    import numpy as np
+
+    fused_names = dict(_QKV_FUSED_NAMES if fused_names is None
+                       else fused_names)
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            if k in fused_names and _is_linear_params(v):
+                for i, name in enumerate(fused_names[k]):
+                    out[name] = {
+                        a: np.split(np.asarray(arr), 3, axis=-1)[i]
+                        for a, arr in v.items()}
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)
+
+
+def merge_split_qkv(params, fused_names=None):
+    """Inverse of :func:`split_fused_qkv`: concatenate ``q``/``k``/``v``
+    linears back into one fused ``qkv`` linear (last-axis concat in
+    Megatron order). Only merges when all three split names are present
+    as linear-param subtrees."""
+    import numpy as np
+
+    fused_names = dict(_QKV_FUSED_NAMES if fused_names is None
+                       else fused_names)
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        done = set()
+        for fused, names in fused_names.items():
+            if all(n in tree and _is_linear_params(tree[n]) for n in names):
+                if fused in tree:
+                    raise ValueError(
+                        f"cannot merge {names} into {fused!r}: the "
+                        f"subtree already contains a {fused!r} entry "
+                        f"(mixed-layout checkpoint); resolve the "
+                        f"collision before merging")
+                out[fused] = {
+                    a: np.concatenate(
+                        [np.asarray(tree[n][a]) for n in names], axis=-1)
+                    for a in tree[names[0]]}
+                done.update(names)
+        for k, v in tree.items():
+            if k not in done:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)

@@ -259,3 +259,55 @@ def test_module_level_amp_surface():
         {"w": jnp.ones((2,), jnp.float32)}, FusedAdam(lr=1e-3),
         opt_level="O1", verbosity=0)
     assert list(amp.master_params(opt1.init(p1))) == []
+
+
+def test_o1_cast_cache_contract():
+    """Mirror of upstream ``tests/L0/run_amp/test_cache.py`` (SURVEY.md
+    §4): apex's O1 cast cache guarantees (a) a weight used by several
+    whitelisted ops inside one iteration is cast ONCE, and (b) results
+    are identical to explicitly pre-casting the weight. Trace-time
+    autocast makes the cache structural — XLA CSE dedupes the repeated
+    converts — but the observable contract deserves its own test."""
+    w = jnp.ones((8, 8), jnp.float32) * 1.5
+    x1 = jnp.ones((4, 8), jnp.float32)
+    x2 = jnp.full((4, 8), 2.0, jnp.float32)
+
+    def fn(x1, x2, w):
+        with amp.autocast():
+            return jnp.matmul(x1, w) + jnp.matmul(x2, w)
+
+    # (b) identical results to the explicit single pre-cast
+    expect = (jnp.matmul(x1.astype(jnp.bfloat16), w.astype(jnp.bfloat16))
+              + jnp.matmul(x2.astype(jnp.bfloat16), w.astype(jnp.bfloat16)))
+    got = jax.jit(fn)(x1, x2, w)
+    assert got.dtype == expect.dtype
+    assert jnp.array_equal(got, expect)
+
+    # (a) single cast of w in the optimized program: both matmuls read
+    # ONE convert of the weight (the cast-cache contract, via CSE)
+    hlo = jax.jit(fn).lower(x1, x2, w).compile().as_text()
+    import re
+    converts = [l for l in hlo.splitlines()
+                if re.search(r"convert.*bf16\[8,8\]", l)
+                and "f32[8,8]" in l]
+    assert len(converts) <= 1, converts
+
+
+def test_o1_cache_invalidation_across_steps():
+    """The second half of the upstream cache test: after a weight
+    UPDATE, the next iteration's cast must see the new value (apex
+    invalidates the cache each step; here every trace/execution recasts
+    by construction). Guards against any future memoization of casts
+    across calls."""
+    w = jnp.ones((4, 4), jnp.float32)
+    x = jnp.ones((2, 4), jnp.float32)
+
+    @jax.jit
+    def fwd(x, w):
+        with amp.autocast():
+            return jnp.matmul(x, w)
+
+    y1 = fwd(x, w)
+    w2 = w * 3.0  # optimizer-step analog
+    y2 = fwd(x, w2)
+    assert jnp.array_equal(y2, y1 * 3.0)

@@ -102,12 +102,49 @@ def test_causal_loader_covers_corpus():
         np.sort(got.reshape(-1)), np.sort(corpus.reshape(-1)))
 
 
-def test_drop_last_validation():
+def test_drop_last_true_drops_tail():
     corpus = np.zeros((10, 4), np.int32)
-    with pytest.raises(NotImplementedError):
-        CausalLMBatchLoader(corpus, batch_size=3, drop_last=False)
     loader = CausalLMBatchLoader(corpus, batch_size=3)  # drop_last
     assert len(loader) == 3
+    assert all(b.shape == (3, 4) for b in loader)
+
+
+def test_drop_last_false_pads_and_masks_tail():
+    """torch-DataLoader parity with static shapes: the epoch tail is
+    padded to batch_size and masked via per-sample weights."""
+    corpus = np.arange(10 * 4, dtype=np.int32).reshape(10, 4)
+    loader = CausalLMBatchLoader(corpus, batch_size=3, drop_last=False,
+                                 shuffle=False, seed=9)
+    assert len(loader) == 4
+    assert [loader.valid_rows(b) for b in range(4)] == [3, 3, 3, 1]
+    batches = list(loader)
+    assert len(batches) == 4
+    for ids, weights in batches:  # static shapes incl. the tail
+        assert ids.shape == (3, 4) and weights.shape == (3,)
+    full_w = np.concatenate([w for _, w in batches])
+    assert full_w.tolist() == [1.0] * 9 + [1.0, 0.0, 0.0]
+    # valid rows cover the whole corpus exactly once
+    got = np.concatenate([ids[w == 1.0] for ids, w in batches])
+    np.testing.assert_array_equal(np.sort(got, axis=0), corpus)
+    with pytest.raises(IndexError):
+        loader.valid_rows(4)
+
+
+def test_drop_last_false_mlm_tail_labels():
+    """MLM padding rows must carry -1 labels (zero loss) and weight 0."""
+    rng = np.random.RandomState(3)
+    corpus = rng.randint(5, 500, (11, 8)).astype(np.int32)
+    loader = MLMBatchLoader(corpus, batch_size=4, vocab_size=500,
+                            mask_id=3, special_ids=[0, 1, 2, 3],
+                            drop_last=False, seed=5)
+    assert len(loader) == 3
+    batches = list(loader)
+    ids, labels, weights = batches[-1]
+    assert ids.shape == (4, 8) and weights.tolist() == [1, 1, 1, 0]
+    assert (labels[weights == 0.0] == -1).all()
+    # non-tail batches still carry (all-ones) weights: static pytree
+    # structure across the epoch
+    assert all(len(b) == 3 and b[2].all() for b in batches[:-1])
 
 
 def test_prefetch_propagates_worker_exceptions():

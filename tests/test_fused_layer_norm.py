@@ -217,3 +217,54 @@ def test_mode_dependent_selection_agrees():
     train_r, _ = jax.vjp(lambda x: fused_rms_norm_affine(x, w), x)
     np.testing.assert_allclose(np.asarray(infer_r), np.asarray(train_r),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_multidim_normalized_shape_module():
+    """apex parity: FusedLayerNorm((d1, d2)) normalizes over BOTH
+    trailing dims and keeps params at the full normalized_shape
+    (upstream apex/normalization/fused_layer_norm.py accepts tuples)."""
+    import numpy as np
+
+    from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4, 6).astype("f4"))
+    m = FusedLayerNorm(normalized_shape=(4, 6))
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert v["params"]["scale"].shape == (4, 6)
+    assert v["params"]["bias"].shape == (4, 6)
+    y = m.apply(v, x)
+    assert y.shape == x.shape
+    # matches normalizing the flattened trailing dims
+    xf = x.reshape(3, 24)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    ref = ((xf - mean) * jax.lax.rsqrt(var + 1e-5)).reshape(3, 4, 6)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=2e-5)
+
+    r = FusedRMSNorm(normalized_shape=(4, 6))
+    vr = r.init(jax.random.PRNGKey(0), x)
+    assert vr["params"]["scale"].shape == (4, 6)
+    yr = r.apply(vr, x)
+    ms = (xf * xf).mean(-1, keepdims=True)
+    refr = (xf * jax.lax.rsqrt(ms + 1e-5)).reshape(3, 4, 6)
+    np.testing.assert_allclose(np.asarray(yr), np.asarray(refr), atol=2e-5)
+
+    # grads flow through the reshaped path
+    def loss(p):
+        return jnp.sum(m.apply({"params": p}, x) ** 2)
+    g = jax.grad(loss)(v["params"])
+    assert g["scale"].shape == (4, 6)
+    assert np.isfinite(np.asarray(g["scale"])).all()
+
+
+def test_multidim_wrong_trailing_raises():
+    from apex_tpu.normalization import FusedLayerNorm
+
+    x = jnp.zeros((2, 3, 5))
+    m = FusedLayerNorm(normalized_shape=(4, 5))
+    try:
+        m.init(jax.random.PRNGKey(0), x)
+    except ValueError as e:
+        assert "trailing" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
