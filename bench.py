@@ -176,6 +176,11 @@ def marginal_time(advance, fetch, iters, windows=2):
       iters: big-window length; the small window is ``max(iters//4, 1)``.
     """
     n_small = max(iters // 4, 1)
+    if iters <= n_small:  # degenerate window pair (iters=1): no marginal
+        t0 = time.perf_counter()
+        advance(iters)
+        fetch()
+        return (time.perf_counter() - t0) / iters
     marginals = []
     t_big_last = None
     for _ in range(windows):
@@ -301,9 +306,10 @@ def _fetch(state):
     return float(jnp.sum(leaf))
 
 
-def _chain_time(step, state, iters, warmup=2, windows=2):
-    """Microbench timing via :func:`marginal_time`: state evolves
-    through every call (defeats the runtime's result memoization)."""
+def _chain_time_stateful(step, state, iters, warmup=2, windows=2):
+    """(marginal dt, evolved state): the state keeps evolving through
+    warmup and every timed window (defeats the runtime's cross-process
+    result memoization)."""
     for _ in range(warmup):
         state = step(*state)
     _fetch(state)
@@ -313,54 +319,106 @@ def _chain_time(step, state, iters, warmup=2, windows=2):
         for _ in range(n):
             box[0] = step(*box[0])
 
-    return marginal_time(advance, lambda: _fetch(box[0]), iters,
-                         windows=windows)
+    dt = marginal_time(advance, lambda: _fetch(box[0]), iters,
+                       windows=windows)
+    return dt, box[0]
+
+
+def _chain_time(step, state, iters, warmup=2, windows=2):
+    """Microbench timing via :func:`marginal_time`: state evolves
+    through every call (defeats the runtime's result memoization)."""
+    dt, _ = _chain_time_stateful(step, state, iters, warmup, windows)
+    return dt
+
+
+def _ab_chain_time(step_a, step_b, state, iters, rounds=3):
+    """INTERLEAVED A/B timing for ratio metrics: alternate the two arms
+    round-robin and report each arm's best marginal.
+
+    Round-5 lesson (the LN microbench regression post-mortem): timing
+    arm A fully and then arm B exposes the RATIO to tunnel/runtime
+    drift between the two measurement periods — the same code measured
+    0.85x (driver), 0.92x, and 1.14x across sessions purely by when
+    each arm ran. Alternating rounds puts both arms through the same
+    drift, and min-per-arm discards the contended rounds.
+
+    Each arm's state THREADS ACROSS ROUNDS (round 2 continues from
+    round 1's evolved carry): restarting from the shared initial state
+    would replay a bit-identical (program, inputs) sequence that the
+    runtime memoizer serves from cache, and min() would then pick the
+    cache-serve time."""
+    t_a, t_b = [], []
+    s_a = s_b = state
+    for _ in range(rounds):
+        dt, s_a = _chain_time_stateful(step_a, s_a, iters)
+        t_a.append(dt)
+        dt, s_b = _chain_time_stateful(step_b, s_b, iters)
+        t_b.append(dt)
+    return min(t_a), min(t_b)
 
 
 def bench_layer_norm():
-    """BASELINE configs[1]: FusedLayerNorm (Pallas training path) vs
-    stock-XLA LN, fwd+bwd at the BERT-large shape. Value = speedup (x).
+    """BASELINE configs[1]: FusedLayerNorm (training dispatch: XLA-fused
+    fwd + Pallas bwd) vs stock-XLA LN, fwd+bwd at the shape the
+    dispatcher serves — LN between GEMMs (the pre-LN transformer-block
+    context), 16 block applications per timed call at the BERT-large
+    (8192, 1024) activation shape. Value = speedup (x).
 
-    Sizing note (round 4): each timed call runs 64 chained LN fwd+bwd
-    applications so one call costs ~8 ms of real work — the per-window
-    sync noise on this runtime swings +/-1.3 ms of marginal, and a
-    smaller workload (round 3 used 8 applications under the old
-    window-overhead-diluted timing) left the ratio inside the noise
-    floor. Expected value ~1.0: BOTH paths run at the ~80%-of-roofline
-    bandwidth bound at H=1024 (measured 2026-07-31); the Pallas path's
-    real win is ~3 ms at the full-step headline (in-kernel dgamma
-    accumulation + recompute bwd) and is recorded there. A reading far
-    below 1.0 (e.g. the 0.66x a pipeline-stalling accumulator produced)
-    still flags a kernel regression."""
+    Post-mortem of the round-4 regression (VERDICT r4 weak #1): the old
+    microbench chained 64 BARE LN+residual applications — a shape where
+    XLA fuses each LN into the neighboring adds across the whole chain,
+    while every standalone Pallas kernel is an HBM fusion barrier; it
+    also (until round 4) only differentiated x, so the stock arm never
+    computed dgamma/dbeta at all. At that shape the all-Pallas pair
+    honestly loses ~10% — but it is not the shape the mode dispatcher
+    serves. Measured at THIS shape (v5e, marginal timing, 2026-07-31):
+    stock 7.01 ms/call, all-Pallas 7.23, hybrid 5.19 — the round-5
+    dispatch (jnp fwd so XLA fuses LN into the GEMM that consumes it;
+    Pallas bwd for the one-pass dx + in-kernel dgamma/dbeta) wins
+    ~1.35x, which is the honest kernel-tier claim. Gradients flow to
+    x, the LN affine params, AND the GEMM weights (the training
+    contract; dgamma/dbeta work is paid by both arms)."""
     from apex_tpu.ops.layer_norm import fused_layer_norm_affine
-
-    x0 = jax.random.normal(jax.random.PRNGKey(_SALT), (16 * 512, 1024),
-                           jnp.float32)
-    w = jnp.ones((1024,), jnp.float32)
-    b = jnp.zeros((1024,), jnp.float32)
-
     from apex_tpu.ops.layer_norm import layer_norm_reference as stock_ln
 
+    N, H = 16 * 512, 1024
+    ks = jax.random.split(jax.random.PRNGKey(_SALT), 4)
+    x0 = jax.random.normal(ks[0], (N, H), jnp.float32)
+    w0 = jnp.ones((H,), jnp.float32)
+    b0 = jnp.zeros((H,), jnp.float32)
+    W1 = jax.random.normal(ks[1], (H, H), jnp.float32) * 0.03
+    W2 = jax.random.normal(ks[2], (H, H), jnp.float32) * 0.03
+
     def mk(fn):
-        def many(xb, w, b):
-            for _ in range(64):
-                xb = fn(xb, w, b) + xb * 0.5
-            return xb
+        def block(xb, w, b, W1b, W2b):
+            h = jnp.dot(fn(xb, w, b), W1b)
+            return jnp.dot(jax.nn.gelu(h), W2b) + xb
 
         @jax.jit
-        def step(x):
-            def loss(x):
-                return jnp.sum(many(x.astype(jnp.bfloat16), w, b)
-                               .astype(jnp.float32) ** 2)
-            dx = jax.grad(loss)(x)
-            # f32 carry with a bounded f32-visible update: a bf16 carry
+        def step(x, w, b, W1, W2):
+            # W1/W2 are ARGUMENTS inside argnums: as closure constants
+            # their cotangent matmuls and saved-activation traffic would
+            # be dead-code-eliminated — the same DCE understatement the
+            # round-4 post-mortem above describes for dgamma/dbeta
+            def loss(x, w, b, W1, W2):
+                xb = x.astype(jnp.bfloat16)
+                W1b, W2b = W1.astype(jnp.bfloat16), W2.astype(jnp.bfloat16)
+                for _ in range(16):
+                    xb = block(xb, w, b, W1b, W2b)
+                return jnp.sum(xb.astype(jnp.float32) ** 2) / N
+            dx, dw, db, dW1, dW2 = jax.grad(
+                loss, argnums=(0, 1, 2, 3, 4))(x, w, b, W1, W2)
+            # f32 carries with bounded f32-visible updates: a bf16 carry
             # with a tiny step rounds back to the identical input and
             # the runtime memoizer serves the call from cache
-            return (0.999 * x - 1e-3 * jnp.tanh(dx),)
+            return (0.999 * x - 1e-3 * jnp.tanh(dx),
+                    w - 1e-4 * jnp.tanh(dw), b - 1e-4 * jnp.tanh(db),
+                    W1 - 1e-4 * jnp.tanh(dW1), W2 - 1e-4 * jnp.tanh(dW2))
         return step
 
-    dt_fused = _chain_time(mk(fused_layer_norm_affine), (x0,), iters=8)
-    dt_stock = _chain_time(mk(stock_ln), (x0,), iters=8)
+    state = (x0, w0, b0, W1, W2)
+    dt_fused, dt_stock = _ab_chain_time(
+        mk(fused_layer_norm_affine), mk(stock_ln), state, iters=8)
     return {
         "metric": "fused_layer_norm_fwdbwd_speedup_vs_xla",
         "value": round(dt_stock / dt_fused, 3),
@@ -444,32 +502,18 @@ def bench_fused_lamb():
     }
 
 
-_HLO_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
-                    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1,
-                    "u8": 1, "pred": 1}
-
-
 def count_allreduce_bytes(hlo_text):
     """(op_count, total_bytes) of all-reduce collectives in compiled HLO
-    text — the framework-attributable synchronization traffic of a step,
-    exactly measurable where wall-clock on a shared-core virtual mesh is
-    not. Handles scalar, array, and tuple-shaped all-reduces."""
-    import re
+    text. Round 5: thin wrapper over the general
+    :mod:`apex_tpu.utils.hlo_audit` (which also counts all-gather /
+    reduce-scatter / all-to-all / collective-permute, so a grad sync
+    that silently migrated from all-reduce to a reduce-scatter +
+    all-gather pair is caught by the companion ``other_bytes`` field of
+    the ddp metric rather than reading as an improvement)."""
+    from apex_tpu.utils.hlo_audit import collective_stats
 
-    ops, total = 0, 0
-    for line in hlo_text.splitlines():
-        m = re.search(r"=\s*(.*?)\s+all-reduce(?:-start)?\(", line)
-        if not m:
-            continue
-        ops += 1
-        for dt, dims in re.findall(r"([a-z]+\d+|pred)\[([\d,]*)\]",
-                                   m.group(1)):
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * _HLO_DTYPE_BYTES.get(dt, 4)
-    return ops, total
+    s = collective_stats(hlo_text)["all-reduce"]
+    return s["ops"], s["bytes"]
 
 
 _DDP_SCALING_CHILD = r"""
@@ -525,9 +569,15 @@ step = jax.jit(jax.shard_map(
 hlo = step.lower(variables, xb, yb).compile().as_text()
 grad_bytes = sum(l.size * 4 for l in jax.tree.leaves(variables["params"]))
 sys.path.insert(0, sys.argv[3])
-import bench
-ops, bytes_ = bench.count_allreduce_bytes(hlo)
-print(json.dumps({"ops": ops, "bytes": bytes_, "grad_bytes": grad_bytes}))
+from apex_tpu.utils.hlo_audit import collective_stats
+st = collective_stats(hlo)
+other = {k: v for k, v in st.items()
+         if k not in ("all-reduce", "total") and v["ops"]}
+print(json.dumps({"ops": st["all-reduce"]["ops"],
+                  "bytes": st["all-reduce"]["bytes"],
+                  "other_ops": sum(v["ops"] for v in other.values()),
+                  "other_bytes": sum(v["bytes"] for v in other.values()),
+                  "grad_bytes": grad_bytes}))
 """
 
 
@@ -572,7 +622,9 @@ def bench_ddp_scaling():
     stats = run("sync")
     ratio = stats["bytes"] / stats["grad_bytes"]
     print(f"# ddp collective audit: {stats['ops']} all-reduces "
-          f"({stats['bytes']} B) vs grad bytes {stats['grad_bytes']}",
+          f"({stats['bytes']} B) vs grad bytes {stats['grad_bytes']}; "
+          f"other collectives: {stats['other_ops']} op "
+          f"({stats['other_bytes']} B)",
           file=sys.stderr)
     return {
         "metric": "ddp_syncbn_allreduce_bytes_over_grad_bytes_8dev",
@@ -580,6 +632,54 @@ def bench_ddp_scaling():
         "unit": "ratio",
         "vs_baseline": round(ratio, 3),
         "allreduce_ops": stats["ops"],
+        # grad traffic migrated to reduce-scatter/all-gather/all-to-all
+        # would land HERE instead of lowering the headline ratio
+        # (advisor r4 #3); expected ~0 for this all-reduce-only step
+        "other_collective_bytes": stats["other_bytes"],
+    }
+
+
+def bench_scaled_masked_softmax():
+    """FusedScaleMaskSoftmax kernel tier vs stock jnp softmax at the
+    BERT-shaped (B, H, S, S) = (16, 16, 512, 512) attention-score
+    tensor, fwd+bwd with a padding mask (VERDICT r4 weak #7: the
+    softmax tier was justified on speed but had no perf row). This is
+    the tier the composed-attention path uses when flash is off — the
+    reference justifies ``scaled_masked_softmax_cuda`` purely on this
+    comparison (SURVEY §2.2). 4 chained applications/call keep the
+    workload above the window-noise floor (each app is a ~268 MB bf16
+    tensor fwd+bwd). Interleaved A/B + min-per-arm, like the LN row."""
+    from apex_tpu.ops.softmax import scaled_masked_softmax, softmax_reference
+
+    B, H, S = 16, 16, 512
+    x0 = jax.random.normal(jax.random.PRNGKey(_SALT), (B, H, S, S),
+                           jnp.float32)
+    mask = (jax.random.uniform(jax.random.PRNGKey(1), (B, 1, 1, S))
+            > 0.9)  # ~10% padded keys
+
+    def mk(fn):
+        def many(xb):
+            for _ in range(4):
+                xb = fn(xb, mask, 0.125) + 0.5 * xb
+            return xb
+
+        @jax.jit
+        def step(x):
+            def loss(x):
+                return jnp.sum(many(x.astype(jnp.bfloat16))
+                               .astype(jnp.float32) ** 2)
+            dx = jax.grad(loss)(x)
+            return (0.999 * x - 1e-3 * jnp.tanh(dx),)
+        return step
+
+    dt_fused, dt_stock = _ab_chain_time(
+        mk(scaled_masked_softmax),
+        mk(lambda x, m, s: softmax_reference(x, m, s)), (x0,), iters=6)
+    return {
+        "metric": "scaled_masked_softmax_fwdbwd_speedup_vs_xla",
+        "value": round(dt_stock / dt_fused, 3),
+        "unit": "x",
+        "vs_baseline": round(dt_stock / dt_fused, 3),
     }
 
 
@@ -614,9 +714,12 @@ def bench_long_context(seq=4096):
 
     flash_step = mk(lambda x: flash_attention(x, x, x, None, True, 0.125))
     comp_step = mk(lambda x: mha_reference(x, x, x, None, True, 0.125))
-    dt_flash = _chain_time(flash_step, (q0,), iters=4)
-    _reset()
-    dt_comp = _chain_time(comp_step, (q0,), iters=4)
+    # Interleaved A/B (round 5): the round-4 driver recorded 1.496x for
+    # the same code that measured 2.8x in-session — sequential arms let
+    # tunnel drift land entirely on one side. Alternating rounds +
+    # min-per-arm brought the spread to +/-15% across sessions.
+    dt_flash, dt_comp = _ab_chain_time(flash_step, comp_step, (q0,),
+                                       iters=4)
     return {
         "metric": f"long_context_attn_s{seq}_flash_speedup_vs_composed",
         "value": round(dt_comp / dt_flash, 3),
@@ -658,7 +761,15 @@ def main():
     # (S=4096 on TPU by default; add S=2048 with --long-context)
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling]
     if on_tpu:
+        secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)
+
+        def bench_long_context_s8192():
+            # S=8192 row (round 5): the composed baseline's (1,16,S,S)
+            # fp32 score tensor is ~4 GB here — the shape where the
+            # flash kernel's O(S*D) memory stops being a luxury
+            return bench_long_context(seq=8192)
+        secondary.append(bench_long_context_s8192)
         if "--long-context" in sys.argv:
             def bench_long_context_s2048():
                 return bench_long_context(seq=2048)
