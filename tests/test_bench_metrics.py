@@ -105,3 +105,137 @@ def test_marginal_time_all_windows_corrupted_falls_back_positive():
     finally:
         time_mod.perf_counter = real
     assert dt > 0
+
+
+# ---------------------------------------------------------------------------
+# round 5: the generalized collective audit (apex_tpu.utils.hlo_audit)
+# ---------------------------------------------------------------------------
+
+def _lower_shmap(fn, in_specs, out_specs, *args, n=8, axes=("data",)):
+    mesh = jax.make_mesh((n,), axes)
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False))
+    return f.lower(*args).compile().as_text()
+
+
+def test_collective_stats_identifies_each_kind():
+    """Every collective family must be counted under its own key (the
+    advisor-r4 finding: an all-reduce-only counter reads a grad sync
+    rewritten as reduce-scatter + all-gather as an improvement)."""
+    from apex_tpu.utils.hlo_audit import collective_stats
+
+    x = jnp.ones((8 * 8, 128))
+
+    hlo = _lower_shmap(lambda x: jax.lax.psum(x, "data"),
+                       P("data"), P("data"), x)
+    assert collective_stats(hlo)["all-reduce"]["ops"] >= 1
+
+    hlo = _lower_shmap(lambda x: jax.lax.psum_scatter(
+        x, "data", scatter_dimension=0, tiled=True),
+        P("data"), P("data"), x)
+    s = collective_stats(hlo)
+    assert s["reduce-scatter"]["ops"] >= 1
+
+    hlo = _lower_shmap(lambda x: jax.lax.all_gather(
+        x, "data", axis=0, tiled=True), P("data"), P(), x)
+    assert collective_stats(hlo)["all-gather"]["ops"] >= 1
+
+    hlo = _lower_shmap(lambda x: jax.lax.all_to_all(
+        x, "data", split_axis=1, concat_axis=0, tiled=True),
+        P("data"), P("data", None), x)
+    assert collective_stats(hlo)["all-to-all"]["ops"] >= 1
+
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    hlo = _lower_shmap(lambda x: jax.lax.ppermute(x, "data", perm),
+                       P("data"), P("data"), x)
+    assert collective_stats(hlo)["collective-permute"]["ops"] >= 1
+
+
+def test_collective_stats_total_and_bytes():
+    from apex_tpu.utils.hlo_audit import collective_stats
+
+    text = (
+        "%ar = (f32[32]{0}, s32[]) all-reduce(%a, %b), replica_groups={}\n"
+        "%ag = bf16[64,128]{1,0} all-gather-start(%c)\n"
+        "%rs = f32[8]{0} reduce-scatter(%d)\n"
+        "%cp = f32[16]{0} collective-permute(%e)\n"
+        "%a2a = f32[4,4]{1,0} all-to-all(%f)\n"
+        "%noise = f32[9]{0} add(%x, %y)\n"
+    )
+    s = collective_stats(text)
+    assert s["all-reduce"] == {"ops": 1, "bytes": 32 * 4 + 4}
+    assert s["all-gather"] == {"ops": 1, "bytes": 64 * 128 * 2}
+    assert s["reduce-scatter"] == {"ops": 1, "bytes": 32}
+    assert s["collective-permute"] == {"ops": 1, "bytes": 64}
+    assert s["all-to-all"] == {"ops": 1, "bytes": 64}
+    assert s["total"]["ops"] == 5
+
+
+def test_collective_audit_catches_migrated_grad_sync():
+    """The deliberate regression for the ddp metric's companion field:
+    replace the all-reduce grad sync with reduce-scatter + all-gather
+    (same bytes moved, zero all-reduce bytes). The generalized stats
+    must expose the migrated traffic."""
+    from apex_tpu.utils.hlo_audit import collective_stats
+
+    p = jnp.ones((64, 16))
+    x = jnp.ones((8 * 2, 64))
+
+    def step(migrated, p, x):
+        g = jax.grad(lambda p: jnp.mean((x @ p) ** 2))(p)
+        if migrated:
+            shard = jax.lax.psum_scatter(
+                g.reshape(-1), "data", scatter_dimension=0, tiled=True)
+            g = jax.lax.all_gather(shard, "data", axis=0,
+                                   tiled=True).reshape(g.shape)
+        else:
+            g = jax.lax.psum(g, "data")
+        return p - 1e-3 * g
+
+    import functools
+
+    def lower(migrated):
+        mesh = jax.make_mesh((8,), ("data",))
+        f = jax.jit(jax.shard_map(
+            functools.partial(step, migrated), mesh=mesh,
+            in_specs=(P(), P("data")), out_specs=P(),
+            check_vma=False))  # all_gather output replication isn't
+        return f.lower(p, x).compile().as_text()  # statically inferable
+
+    hlo_ar, hlo_mig = lower(False), lower(True)
+    s_ar, s_mig = collective_stats(hlo_ar), collective_stats(hlo_mig)
+    # the naive all-reduce-only view: migration reads as "improvement"
+    assert s_mig["all-reduce"]["bytes"] < s_ar["all-reduce"]["bytes"]
+    # the generalized view catches it
+    migrated_bytes = (s_mig["reduce-scatter"]["bytes"]
+                      + s_mig["all-gather"]["bytes"])
+    assert migrated_bytes >= 64 * 16 * 4
+
+
+def test_ulysses_attention_all_to_all_count():
+    """Program-shape contract of the Ulysses layer (SURVEY §2.3 CP row):
+    4 all_to_alls in forward (q, k, v to heads; out back to sequence)
+    and 4 in backward (AD of all_to_all is its inverse)."""
+    from apex_tpu.ops.ulysses_attention import ulysses_attention
+    from apex_tpu.utils.hlo_audit import collective_stats
+
+    B, H, S, D = 2, 4, 16, 8
+    rng = np.random.RandomState(0)
+    # distinct q/k/v: identical operands would let CSE merge their
+    # all_to_alls and undercount the real model's program shape
+    q, k, v = (jnp.asarray(rng.randn(B, H, S // 2, D).astype("f4"))
+               for _ in range(3))
+
+    def step(q, k, v):
+        def loss(q, k, v):
+            o = ulysses_attention(q, k, v, axis_name="context",
+                                  causal=True, scale=0.3)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    mesh = jax.make_mesh((2,), ("context",), devices=jax.devices()[:2])
+    spec = P(None, None, "context")
+    f = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,) * 3,
+                              out_specs=(spec,) * 3))
+    hlo = f.lower(q, k, v).compile().as_text()
+    assert collective_stats(hlo)["all-to-all"]["ops"] == 8
