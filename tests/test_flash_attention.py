@@ -7,6 +7,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Interpret-mode Pallas kernels on CPU are the suite's dominant cost
+# (~5 min for this tier alone); fast CI runs -m "not slow", the full
+# run and the on-TPU tier keep the coverage.
+pytestmark = pytest.mark.slow
+
 from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 
 

@@ -8,6 +8,11 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+# Interpret-mode Pallas kernels on CPU are the suite's dominant cost
+# (~5 min for this tier alone); fast CI runs -m "not slow", the full
+# run and the on-TPU tier keep the coverage.
+pytestmark = pytest.mark.slow
+
 from apex_tpu.ops.ring_attention import (
     ring_attention,
     ring_attention_reference,
