@@ -1,0 +1,39 @@
+"""OpenFold kernel tier (reference: ``apex/contrib/openfold_triton/``,
+SURVEY.md §2.2 — the V?-vintage Triton kernels apex ships for OpenFold /
+AlphaFold2 training).
+
+The upstream package provides Triton kernels for the Evoformer's hot
+ops — small-trailing-dim LayerNorm, bias+mask softmax over attention
+scores, gated multi-head attention, and a fused Adam+SWA optimizer step
+(``fused_adam_swa.py``). On TPU each of those maps onto machinery this
+framework already owns; this tier provides the OpenFold-shaped surface:
+
+- :func:`layer_norm` / ``LayerNormSmallShapeOptImpl`` — trailing-dim
+  LayerNorm at the pair/MSA-representation shapes (c_z=128, c_m=256),
+  dispatching to the Pallas training kernels of
+  :mod:`apex_tpu.ops.layer_norm`.
+- :func:`softmax` — ``softmax(scale*x + bias, mask)`` over the last dim
+  with the Evoformer's broadcastable pair-bias term, on the fused
+  additive-mask softmax kernels of :mod:`apex_tpu.ops.softmax`.
+- :func:`gated_attention` — the MSA row/column attention core:
+  ``sigmoid(gate) * attn(q, k, v, bias, mask)``.
+- :class:`FusedAdamSWA` — Adam step + stochastic-weight-averaging
+  buffer update in one fused pass over the parameter list.
+"""
+
+from apex_tpu.contrib.openfold.fused_adam_swa import FusedAdamSWA, SWAState
+from apex_tpu.contrib.openfold.kernels import (
+    LayerNormSmallShapeOptImpl,
+    gated_attention,
+    layer_norm,
+    softmax,
+)
+
+__all__ = [
+    "FusedAdamSWA",
+    "SWAState",
+    "LayerNormSmallShapeOptImpl",
+    "gated_attention",
+    "layer_norm",
+    "softmax",
+]

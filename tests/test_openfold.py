@@ -1,0 +1,158 @@
+"""contrib.openfold tests (reference: apex/contrib/openfold_triton/ —
+the Evoformer kernel tier + FusedAdamSWA; SURVEY.md §2.2 V? row)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.openfold import (
+    FusedAdamSWA,
+    LayerNormSmallShapeOptImpl,
+    gated_attention,
+    layer_norm,
+    softmax,
+)
+
+
+def _ln_ref(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def test_layer_norm_pair_representation_shape():
+    # (B, N, N, c_z) with c_z=128 — the pair-rep LayerNorm shape the
+    # Triton tier was built for
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 128).astype("f4"))
+    w = jnp.asarray(rng.rand(128).astype("f4") + 0.5)
+    b = jnp.asarray(rng.randn(128).astype("f4"))
+    np.testing.assert_allclose(np.asarray(layer_norm(x, w, b)),
+                               np.asarray(_ln_ref(x, w, b)),
+                               atol=2e-5, rtol=2e-5)
+    # grads flow to all three
+    g = jax.grad(lambda x, w, b: jnp.sum(layer_norm(x, w, b) ** 2),
+                 argnums=(0, 1, 2))(x, w, b)
+    assert all(np.isfinite(np.asarray(t)).all() for t in g)
+
+
+def test_layer_norm_small_shape_impl_apply():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 6, 64).astype("f4"))
+    w = jnp.ones((64,), jnp.float32)
+    b = jnp.zeros((64,), jnp.float32)
+    y = LayerNormSmallShapeOptImpl.apply(x, (64,), w, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ln_ref(x, w, b)),
+                               atol=2e-5)
+
+
+def test_softmax_bias_mask_matches_composition():
+    """softmax(scale*x + pair_bias) with a padding mask must equal the
+    jnp composition — the Evoformer score softmax contract."""
+    rng = np.random.RandomState(2)
+    B, s, H, N = 2, 3, 4, 16
+    x = jnp.asarray(rng.randn(B, s, H, N, N).astype("f4"))
+    bias = jnp.asarray(rng.randn(B, 1, H, N, N).astype("f4"))
+    mask = jnp.asarray(rng.rand(B, 1, 1, 1, N) > 0.8)
+
+    got = softmax(x, mask=mask, bias=bias, scale=0.25)
+    xf = x * 0.25 + bias
+    xf = jnp.where(mask, -1e9, xf)
+    want = jax.nn.softmax(xf, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    # masked probabilities are exactly renormalized away
+    assert float(jnp.max(jnp.where(mask, got, 0.0))) < 1e-6
+
+
+def test_gated_attention_matches_manual():
+    rng = np.random.RandomState(3)
+    B, H, S, D = 2, 4, 8, 16
+    q, k, v, gate = (jnp.asarray(rng.randn(B, H, S, D).astype("f4"))
+                     for _ in range(4))
+    bias = jnp.asarray(rng.randn(B, H, S, S).astype("f4") * 0.1)
+    scale = 1.0 / np.sqrt(D)
+
+    got = gated_attention(q, k, v, gate, bias=bias, scale=scale)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + bias
+    want = jax.nn.sigmoid(gate) * jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_fused_adam_swa_matches_fused_adam_plus_average():
+    """The fused step must equal FusedAdam's update followed by the SWA
+    EMA — fusion is an implementation economy, not new math."""
+    from apex_tpu.optimizers import FusedAdam
+
+    rng = np.random.RandomState(4)
+    params = {"w": jnp.asarray(rng.randn(8, 8).astype("f4")),
+              "b": jnp.asarray(rng.randn(8).astype("f4"))}
+    grads = jax.tree.map(lambda p: p * 0.1, params)
+
+    d = 0.75
+    swa_opt = FusedAdamSWA(lr=1e-2, weight_decay=0.01, swa_decay_rate=d)
+    ref_opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+    st = swa_opt.init(params)
+    rst = ref_opt.init(params)
+    # fresh state: the average starts at the initial params
+    jax.tree.map(lambda s, p: np.testing.assert_array_equal(
+        np.asarray(s), np.asarray(p)), st.swa, params)
+
+    p1, st1 = swa_opt.step(grads, st, params)
+    rp1, _ = ref_opt.step(grads, rst, params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), p1, rp1)
+    want_swa = jax.tree.map(
+        lambda s, p: d * s + (1 - d) * p.astype(jnp.float32),
+        st.swa, p1)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), st1.swa, want_swa)
+
+    # swa_params casts to the model dtypes
+    out = swa_opt.swa_params(st1, like=params)
+    assert jax.tree.leaves(out)[0].dtype == jnp.float32
+
+
+def test_fused_adam_swa_skip_and_masters():
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    grads = {"w": jnp.full((4, 4), 0.1, jnp.bfloat16)}
+    opt = FusedAdamSWA(lr=1e-2, master_weights=True)
+    st = opt.init(params)
+    assert jax.tree.leaves(st.master)[0].dtype == jnp.float32
+
+    # overflow skip: nothing moves, counter does not advance
+    p2, st2 = opt.step(grads, st, params, skip_if=jnp.asarray(True))
+    np.testing.assert_array_equal(np.asarray(p2["w"], np.float32),
+                                  np.asarray(params["w"], np.float32))
+    assert int(st2.step) == 0
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), st2.swa, st.swa)
+
+    # real step: swa tracks the fp32 MASTER trajectory, not the bf16 cast
+    p3, st3 = opt.step(grads, st, params, skip_if=jnp.asarray(False))
+    assert int(st3.step) == 1
+    want = jax.tree.map(
+        lambda s, m: 0.9 * s + 0.1 * m, st.swa, st3.master)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), st3.swa, want)
+    assert p3["w"].dtype == jnp.bfloat16
+
+
+def test_fused_adam_swa_under_jit():
+    params = {"w": jnp.ones((8,), jnp.float32)}
+    grads = {"w": jnp.full((8,), 0.2)}
+    opt = FusedAdamSWA(lr=1e-3)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        return opt.step(grads, s, p)
+
+    p, s = step(params, st)
+    p, s = step(p, s)
+    assert int(s.step) == 2
+    assert np.isfinite(np.asarray(s.swa["w"])).all()
