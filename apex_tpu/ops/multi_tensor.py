@@ -51,6 +51,26 @@ def _f32(t: Array) -> Array:
     return t.astype(jnp.float32)
 
 
+def stochastic_round(x: Array, dtype, key) -> Array:
+    """Stochastically round fp32 ``x`` to ``dtype`` (bf16): add uniform
+    bits below the target mantissa, truncate. E[round(x)] == x, which
+    keeps low-precision EMA state (optimizer moments) from stalling when
+    per-step increments round-to-nearest to zero — the reason the
+    bf16-moments optimizer tier exists. Non-finite values pass through
+    unperturbed. fp32 targets return a plain cast (no-op rounding)."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.float32:
+        return x.astype(dtype)
+    if dtype != jnp.bfloat16:
+        raise NotImplementedError(
+            f"stochastic_round supports bf16/f32 targets, got {dtype}")
+    bits = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    xi = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    trunc = jax.lax.bitcast_convert_type(
+        (xi + bits) & jnp.uint32(0xFFFF0000), jnp.float32)
+    return jnp.where(jnp.isfinite(x), trunc, x).astype(dtype)
+
+
 def _check_parallel(tensor_lists) -> None:
     """Parallel tensor lists must have equal length (the flat-buffer design
     failed loudly on mismatch; per-leaf zips would truncate silently)."""
@@ -337,6 +357,40 @@ def multi_tensor_sgd(
 # LAMB  (csrc/multi_tensor_lamb.cu + lamb_stage_1/2)
 # ---------------------------------------------------------------------------
 
+def lamb_scalars(beta1, beta2, step, bias_correction, grad_averaging,
+                 global_grad_norm, max_global_grad_norm,
+                 grad_pre_scale=1.0):
+    """(clip, bc1, bc2, beta3): the scalar prelude shared by LAMB
+    stage 1 and the bf16-moments path (one definition — the two paths
+    must compute the SAME optimizer)."""
+    clip = jnp.where(
+        global_grad_norm > max_global_grad_norm,
+        max_global_grad_norm / global_grad_norm,
+        1.0,
+    ) if max_global_grad_norm > 0 else jnp.float32(1.0)
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+    return clip * grad_pre_scale, bc1, bc2, beta3
+
+
+def lamb_update_direction(m32, v32, p32, bc1, bc2, eps, weight_decay):
+    """Adam-style update direction with decoupled wd (fp32 inputs)."""
+    u = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+    if weight_decay != 0.0:
+        u = u + weight_decay * p32
+    return u
+
+
+def lamb_trust_ratio(w_norm, u_norm):
+    """Reference trust-ratio rule: ||p||/||u||, 1.0 when either is 0."""
+    return jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
+                     jnp.float32(1.0))
+
+
 def multi_tensor_lamb_stage1(
     chunk_size, noop_flag, tensor_lists, beta1, beta2, eps, step,
     bias_correction, weight_decay, grad_averaging, global_grad_norm,
@@ -357,19 +411,9 @@ def multi_tensor_lamb_stage1(
     _check_parallel(tensor_lists)
     g_list, p_list, m_list, v_list = tensor_lists
 
-    clip = jnp.where(
-        global_grad_norm > max_global_grad_norm,
-        max_global_grad_norm / global_grad_norm,
-        1.0,
-    ) if max_global_grad_norm > 0 else jnp.float32(1.0)
-    clip = clip * grad_pre_scale
-
-    if bias_correction:
-        bc1 = 1.0 - beta1 ** step
-        bc2 = 1.0 - beta2 ** step
-    else:
-        bc1 = bc2 = 1.0
-    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+    clip, bc1, bc2, beta3 = lamb_scalars(
+        beta1, beta2, step, bias_correction, grad_averaging,
+        global_grad_norm, max_global_grad_norm, grad_pre_scale)
 
     updates, new_m, new_v = [], [], []
     for g, p, m, v in zip(g_list, p_list, m_list, v_list):
@@ -377,10 +421,8 @@ def multi_tensor_lamb_stage1(
         p32 = _f32(p)
         m32 = beta1 * _f32(m) + beta3 * g32
         v32 = beta2 * _f32(v) + (1.0 - beta2) * g32 * g32
-        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
-        if weight_decay != 0.0:
-            update = update + weight_decay * p32
-        updates.append(update)
+        updates.append(lamb_update_direction(m32, v32, p32, bc1, bc2,
+                                             eps, weight_decay))
         new_m.append(m32)
         new_v.append(v32)
     return updates, new_m, new_v
@@ -412,9 +454,7 @@ def multi_tensor_lamb_stage2(
         if apply_ratio:
             w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
             u_norm = jnp.sqrt(jnp.sum(jnp.square(u32)))
-            ratio = jnp.where(
-                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.float32(1.0)
-            )
+            ratio = lamb_trust_ratio(w_norm, u_norm)
         else:
             ratio = jnp.float32(1.0)
         stepped = p32 - lr * ratio * u32

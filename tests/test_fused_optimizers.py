@@ -336,3 +336,114 @@ def test_scaled_value_and_grad_defers_unscale():
     np.testing.assert_allclose(
         np.asarray(g_scaled),
         np.asarray(g_unscaled) * float(st.loss_scale), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round 5: bf16-moments LAMB (opt-in low-HBM optimizer tier)
+# ---------------------------------------------------------------------------
+
+def test_lamb_bf16_moments_tracks_fp32_lamb():
+    """One step from zero moments: the bf16-moments path must match the
+    fp32 reference path to bf16-rounding tolerance (same clip, trust
+    ratio, decoupled wd)."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(64, 64).astype("f4") * 0.1),
+              "b": jnp.asarray(rng.randn(64).astype("f4"))}
+    grads = jax.tree.map(lambda p: p * 0.03 + 0.01, params)
+
+    f32_opt = FusedLAMB(lr=1e-2)
+    bf_opt = FusedLAMB(lr=1e-2, moments_dtype="bfloat16",
+                       stochastic_rounding=False)
+    p_ref, s_ref = f32_opt.step(grads, f32_opt.init(params), params)
+    p_bf, s_bf = bf_opt.step(grads, bf_opt.init(params), params)
+
+    assert jax.tree.leaves(s_bf.exp_avg)[0].dtype == jnp.bfloat16
+    for a, b in zip(jax.tree.leaves(p_bf), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-2)
+
+
+def test_lamb_bf16_moments_stochastic_rounding_keeps_ema_alive():
+    """The reason SR exists: a (1-beta2)*g^2 increment far below the
+    current v rounds-to-nearest to ZERO in bf16 and v stalls; with SR
+    the EMA keeps moving in expectation. Run 300 steps of constant
+    small grad against a big initial v and compare drift."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    params = {"w": jnp.ones((64, 64), jnp.float32)}
+    g = {"w": jnp.full((64, 64), 1e-3, jnp.float32)}
+
+    def drift(sr):
+        opt = FusedLAMB(lr=0.0, weight_decay=0.0, max_grad_norm=0.0,
+                        moments_dtype="bfloat16", stochastic_rounding=sr,
+                        bias_correction=False)
+        st = opt.init(params)
+        # big v: increments (1-b2)*g^2 = 1e-9 vs v=1.0 are far below
+        # bf16 resolution (~2^-8)
+        st = st._replace(
+            exp_avg_sq=jax.tree.map(lambda x: jnp.ones_like(x), st.exp_avg_sq))
+
+        @jax.jit
+        def many(p, s):
+            for _ in range(30):
+                p, s = opt.step(g, s, p)
+            return p, s
+
+        p = params
+        for _ in range(10):
+            p, st = many(p, st)
+        # with b2=0.999 over 300 steps from v=1.0 toward g^2~=1e-6,
+        # exact EMA decays v to ~0.74
+        return float(jnp.mean(jnp.asarray(st.exp_avg_sq["w"],
+                                          jnp.float32)))
+
+    v_rne = drift(sr=False)
+    v_sr = drift(sr=True)
+    assert v_rne == 1.0, f"RNE arm should stall exactly, got {v_rne}"
+    assert 0.6 < v_sr < 0.9, (
+        f"SR arm should decay toward the exact EMA (~0.74), got {v_sr}")
+
+
+def test_lamb_bf16_moments_grad_scale_and_skip():
+    """The amp fused tail (grad_scale) and the overflow skip contract
+    hold on the bf16-moments path."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    grads = {"w": jnp.full((8, 8), 64.0, jnp.float32)}  # scaled by 64
+    opt = FusedLAMB(lr=1e-2, moments_dtype="bfloat16")
+    st = opt.init(params)
+    p2, st2, found = opt.step(grads, st, params, grad_scale=64.0)
+    assert not bool(found)
+    assert int(st2.step) == 1
+    assert not np.array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+
+    bad = {"w": grads["w"].at[0, 0].set(jnp.inf)}
+    p3, st3, found3 = opt.step(bad, st, params, grad_scale=64.0)
+    assert bool(found3)
+    np.testing.assert_array_equal(np.asarray(p3["w"]),
+                                  np.asarray(params["w"]))
+    assert int(st3.step) == 0
+
+
+def test_stochastic_round_is_unbiased_and_exact_on_representable():
+    from apex_tpu.ops.multi_tensor import stochastic_round
+
+    key = jax.random.PRNGKey(0)
+    # representable values round exactly regardless of bits
+    x = jnp.asarray([1.0, -2.5, 0.0, 384.0], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(stochastic_round(x, jnp.bfloat16, key), np.float32),
+        np.asarray(x))
+    # non-finite passes through
+    bad = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+    out = np.asarray(stochastic_round(bad, jnp.bfloat16, key), np.float32)
+    assert np.isinf(out[0]) and np.isinf(out[1]) and np.isnan(out[2])
+    # unbiased: mean of many rounds of a midpoint value ~= the value
+    mid = jnp.full((20000,), 1.0 + 2.0 ** -9, jnp.float32)  # halfway ULP
+    r = stochastic_round(mid, jnp.bfloat16, key).astype(jnp.float32)
+    assert abs(float(jnp.mean(r)) - (1.0 + 2.0 ** -9)) < 2e-4
+    # and it actually dithers (both neighbors appear)
+    assert len(np.unique(np.asarray(r))) == 2
