@@ -21,6 +21,7 @@ def _batch(cfg, B=2, S=16, seed=0):
     return ids, types, mask, mlm_labels, nsp
 
 
+@pytest.mark.slow
 def test_forward_shapes():
     cfg = BertConfig.tiny()
     model = BertForPreTraining(cfg)
@@ -31,6 +32,7 @@ def test_forward_shapes():
     assert nsp.shape == (2, 2)
 
 
+@pytest.mark.slow
 def test_bf16_training_step_with_amp_o2_and_lamb():
     """The north-star recipe at tiny scale: amp O2 + FusedLAMB."""
     cfg = BertConfig.tiny(dtype=jnp.bfloat16)
@@ -65,6 +67,7 @@ def test_bf16_training_step_with_amp_o2_and_lamb():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_attention_mask_zeroes_padded_attention():
     cfg = BertConfig.tiny()
     model = BertForPreTraining(cfg)
@@ -78,6 +81,7 @@ def test_attention_mask_zeroes_padded_attention():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_dropout_rng_and_determinism():
     cfg = BertConfig.tiny()
     model = BertForPreTraining(cfg)
@@ -93,6 +97,7 @@ def test_dropout_rng_and_determinism():
     assert not np.allclose(np.asarray(a1), np.asarray(a3))
 
 
+@pytest.mark.slow
 def test_gathered_mlm_head_matches_full_sequence_loss():
     """MLPerf gathered-predictions head (masked_positions): running the
     MLM transform+decoder only on the gathered positions must give the

@@ -144,6 +144,7 @@ def test_no_double_overflow_line_when_ingraph_active(capsys):
         set_verbosity(prev_verbosity)
 
 
+@pytest.mark.slow
 def test_same_seed_bitwise_determinism():
     """SURVEY.md §5 race/determinism row: two runs from the same seed are
     bitwise identical — params, losses, and dropout behavior included."""

@@ -67,6 +67,7 @@ def test_self_multihead_attn_matches_composed(use_mask):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_self_multihead_attn_norm_add_and_dropout_path():
     T, B, H = 8, 2, 32
     attn = SelfMultiheadAttn(H, 4, dropout=0.5, include_norm_add=True)
@@ -84,6 +85,7 @@ def test_self_multihead_attn_norm_add_and_dropout_path():
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(out3))
 
 
+@pytest.mark.slow
 def test_encdec_multihead_attn_shapes_and_grad():
     Tq, Tk, B, H = 6, 10, 2, 32
     attn = EncdecMultiheadAttn(H, 4, dropout=0.0)
@@ -296,6 +298,7 @@ def test_masked_optimizer_keeps_slots_pruned():
 
 # ---------------------------------------------------------- bottleneck
 
+@pytest.mark.slow
 def test_bottleneck_shapes_and_residual():
     x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 16)
                     .astype("float32"))

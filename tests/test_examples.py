@@ -6,6 +6,10 @@ import sys
 
 import pytest
 
+# Example scripts run real (tiny) training loops - the suite's
+# heaviest tier; fast CI runs -m "not slow".
+pytestmark = pytest.mark.slow
+
 
 def _run(module_main, argv):
     old = sys.argv

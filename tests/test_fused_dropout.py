@@ -22,6 +22,7 @@ def test_requires_seed():
 
 
 @pytest.mark.parametrize("shape", [(16, 512, 1024), (3, 7, 11), (100,)])
+@pytest.mark.slow
 def test_statistics_and_determinism(shape):
     x = jnp.ones(shape, jnp.float32)
     rate = 0.1
@@ -59,6 +60,7 @@ def test_backward_replays_identical_mask():
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_layer_trains_with_fused_dropout():
     """End-to-end: a training step through the BERT layer with fused
     hidden+attention dropout produces finite loss and grads."""

@@ -365,6 +365,7 @@ def test_lamb_bf16_moments_tracks_fp32_lamb():
                                    atol=2e-3, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_lamb_bf16_moments_stochastic_rounding_keeps_ema_alive():
     """The reason SR exists: a (1-beta2)*g^2 increment far below the
     current v rounds-to-nearest to ZERO in bf16 and v stalls; with SR

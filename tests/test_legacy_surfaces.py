@@ -49,6 +49,7 @@ def test_weight_norm_direction_invariance():
         np.linalg.norm(np.asarray(w1), axis=0), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_weight_norm_training_with_model():
     """Train the (g, v) parameterization end-to-end through a flax model."""
     model = nn.Dense(1, use_bias=False)

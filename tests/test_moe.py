@@ -95,6 +95,7 @@ def test_route_aux_loss_uniform_is_one():
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.slow
 def test_moe_mlp_matches_per_token_reference(top_k):
     """ep=1 (no mesh): MoEMLP == independent per-token numpy loop."""
     T, H, F, E = 12, 8, 16, 4
@@ -115,6 +116,7 @@ def test_moe_mlp_matches_per_token_reference(top_k):
     assert float(aux) > 0 and float(z) >= 0
 
 
+@pytest.mark.slow
 def test_moe_mlp_grads_flow():
     T, H, F, E = 8, 4, 8, 2
     x = jnp.asarray(np.random.RandomState(2).randn(T, H).astype("float32"))
@@ -303,6 +305,7 @@ class TestTensorExpertParallel:
                                        y_ref, rtol=1e-4, atol=1e-5)
 
 
+    @pytest.mark.slow
     def test_tp_ep_grads_match_assembled(self):
         """Backward through the TPxEP path: gathered per-shard w1 grads
         must equal jax.grad of a dense re-implementation on the
@@ -376,6 +379,7 @@ class TestTensorExpertParallel:
                                    np.asarray(g_ref), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_gpt_moe_block_end_to_end():
     """Tiny MoE-GPT: forward under remat, losses sown, grads finite."""
     from apex_tpu.models.gpt import (

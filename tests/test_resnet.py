@@ -13,6 +13,7 @@ from apex_tpu.models import ResNet, ResNetConfig
 from apex_tpu.optimizers import FusedSGD
 
 
+@pytest.mark.slow
 def test_resnet50_shapes():
     cfg = ResNetConfig.resnet50(num_classes=10)
     model = ResNet(cfg)
@@ -26,6 +27,7 @@ def test_resnet50_shapes():
     assert n_convs >= 49
 
 
+@pytest.mark.slow
 def test_resnet_train_smoke_tiny():
     cfg = ResNetConfig.tiny()
     model = ResNet(cfg)
@@ -59,6 +61,7 @@ def test_resnet_train_smoke_tiny():
     assert losses[-1] < losses[0] * 0.8
 
 
+@pytest.mark.slow
 def test_resnet_dp_syncbn_on_mesh():
     """Data-parallel ResNet with bn_group spanning the mesh: per-device
     batches, synced BN stats, psum'd grads — one train step runs and the
