@@ -207,10 +207,16 @@ def multi_tensor_adam(
     mode,
     bias_correction,
     weight_decay,
+    sr_key=None,
 ):
     """Fused Adam/AdamW update over [grads, params, exp_avg, exp_avg_sq]
     (+ optional trailing fp32 master-param list, mirroring the reference's
     ``master_weights`` variant).
+
+    ``sr_key`` (beyond the reference binding): a PRNG key enabling
+    stochastic rounding of the moment writes — required for unbiased
+    EMAs when the m/v lists are stored in bf16 (the round-5 low-HBM
+    optimizer tier); with fp32 moments it is a no-op.
 
     Returns ``([new_params, new_m, new_v] (+ [new_master]), )`` in fp32
     working precision cast back to the input dtypes.
@@ -228,6 +234,11 @@ def multi_tensor_adam(
     else:
         bc1 = bc2 = 1.0
 
+    def round_to(x, like, key):
+        if key is not None and like.dtype != jnp.float32:
+            return stochastic_round(x, like.dtype, key)
+        return x.astype(like.dtype)
+
     new_p, new_m, new_v, new_master = [], [], [], []
     for i in range(len(g_list)):
         g = _f32(g_list[i])
@@ -243,8 +254,12 @@ def multi_tensor_adam(
             update = update + weight_decay * p
         stepped = p - lr * update
         new_p.append(stepped.astype(p_list[i].dtype))
-        new_m.append(m.astype(m_list[i].dtype))
-        new_v.append(v.astype(v_list[i].dtype))
+        km = kv = None
+        if sr_key is not None:
+            km = jax.random.fold_in(sr_key, 2 * i)
+            kv = jax.random.fold_in(sr_key, 2 * i + 1)
+        new_m.append(round_to(m, m_list[i], km))
+        new_v.append(round_to(v, v_list[i], kv))
         if has_master:
             new_master.append(stepped.astype(master_list[i].dtype))
 

@@ -58,6 +58,31 @@ class FusedOptimizer:
             return None
         return tree_cast(params, jnp.float32)
 
+    # --- shared bf16-moments machinery (round 5): subclasses exposing a
+    # ``moments_dtype`` field share the validation, dtype resolution,
+    # and per-step stochastic-rounding key derivation ---
+
+    def _validate_moments_dtype(self):
+        try:
+            mdt = jnp.dtype(getattr(self, "moments_dtype", "float32"))
+        except TypeError:
+            mdt = None
+        if mdt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+            raise ValueError(
+                f"moments_dtype must be float32 or bfloat16, got "
+                f"{getattr(self, 'moments_dtype', None)!r}")
+
+    @property
+    def _moments_dtype(self):
+        return jnp.dtype(getattr(self, "moments_dtype", "float32"))
+
+    def _sr_key(self, step, seed):
+        """Per-step SR key, or None when fp32 moments / SR disabled."""
+        if (self._moments_dtype == jnp.dtype(jnp.bfloat16)
+                and getattr(self, "stochastic_rounding", False)):
+            return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return None
+
     def _finish_step(self, skip_if, new_params, new_state, params, state):
         """Apply the overflow step-skip select (params, moments, AND the
         step counter stay untouched on skip)."""

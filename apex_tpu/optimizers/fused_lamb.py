@@ -73,15 +73,7 @@ class FusedLAMB(FusedOptimizer):
                 "FusedLAMB only supports adam_w_mode (decoupled weight decay), "
                 "matching the reference kernel."
             )
-        if jnp.dtype(self.moments_dtype) not in (jnp.dtype(jnp.float32),
-                                                 jnp.dtype(jnp.bfloat16)):
-            raise ValueError(
-                f"moments_dtype must be float32 or bfloat16, got "
-                f"{self.moments_dtype}")
-
-    @property
-    def _moments_dtype(self):
-        return jnp.dtype(self.moments_dtype)
+        self._validate_moments_dtype()
 
     def init(self, params) -> LambState:
         mdt = self._moments_dtype
@@ -195,7 +187,7 @@ class FusedLAMB(FusedOptimizer):
         clip, bc1, bc2, beta3 = lamb_scalars(
             b1, b2, step, self.bias_correction, self.grad_averaging,
             global_norm, self.max_grad_norm, pre_scale)
-        key = jax.random.fold_in(jax.random.PRNGKey(0x5A17), step)
+        key = self._sr_key(step, 0x5A17)
         mdt = self._moments_dtype
 
         def u_of(m_r, v_r, p32):
@@ -210,7 +202,7 @@ class FusedLAMB(FusedOptimizer):
             p32 = pi.astype(jnp.float32)
             m32 = b1 * mi.astype(jnp.float32) + beta3 * g32
             v32 = b2 * vi.astype(jnp.float32) + (1.0 - b2) * g32 * g32
-            if self.stochastic_rounding:
+            if key is not None:
                 mo = stochastic_round(m32, mdt, jax.random.fold_in(key, 2 * i))
                 vo = stochastic_round(v32, mdt,
                                       jax.random.fold_in(key, 2 * i + 1))

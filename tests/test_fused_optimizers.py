@@ -448,3 +448,52 @@ def test_stochastic_round_is_unbiased_and_exact_on_representable():
     assert abs(float(jnp.mean(r)) - (1.0 + 2.0 ** -9)) < 2e-4
     # and it actually dithers (both neighbors appear)
     assert len(np.unique(np.asarray(r))) == 2
+
+
+def test_adam_bf16_moments_tracks_fp32_adam():
+    """FusedAdam's bf16-moments tier: one step from zero moments must
+    match the fp32 path to rounding tolerance, and the stored moments
+    must actually be bf16."""
+    rng = np.random.RandomState(7)
+    params = {"w": jnp.asarray(rng.randn(32, 32).astype("f4") * 0.1)}
+    grads = jax.tree.map(lambda p: p * 0.05 + 0.02, params)
+
+    ref = FusedAdam(lr=1e-2, weight_decay=0.01)
+    bf = FusedAdam(lr=1e-2, weight_decay=0.01,
+                   moments_dtype="bfloat16", stochastic_rounding=False)
+    p_ref, _ = ref.step(grads, ref.init(params), params)
+    p_bf, s_bf = bf.step(grads, bf.init(params), params)
+    assert s_bf.exp_avg["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(p_bf["w"]),
+                               np.asarray(p_ref["w"]),
+                               atol=2e-3, rtol=2e-2)
+    with pytest.raises(ValueError):
+        FusedAdam(moments_dtype="float16")
+
+
+def test_adam_bf16_moments_sr_keeps_ema_alive():
+    """Same stall physics as the LAMB test, via the shared
+    multi_tensor_adam sr_key path (short version)."""
+    params = {"w": jnp.ones((32, 32), jnp.float32)}
+    g = {"w": jnp.full((32, 32), 1e-3, jnp.float32)}
+
+    def drift(sr):
+        opt = FusedAdam(lr=0.0, moments_dtype="bfloat16",
+                        stochastic_rounding=sr, bias_correction=False)
+        st = opt.init(params)
+        st = st._replace(exp_avg_sq=jax.tree.map(jnp.ones_like,
+                                                 st.exp_avg_sq))
+
+        @jax.jit
+        def many(p, s):
+            for _ in range(40):
+                p, s = opt.step(g, s, p)
+            return p, s
+
+        p = params
+        for _ in range(5):
+            p, st = many(p, st)
+        return float(jnp.mean(jnp.asarray(st.exp_avg_sq["w"], jnp.float32)))
+
+    assert drift(False) == 1.0          # RNE stalls exactly
+    assert drift(True) < 0.95           # SR decays toward the true EMA
