@@ -400,7 +400,13 @@ def bench_layer_norm():
     from apex_tpu.ops.layer_norm import fused_layer_norm_affine
     from apex_tpu.ops.layer_norm import layer_norm_reference as stock_ln
 
-    N, H = 16 * 512, 1024
+    # Off-TPU this is a flow smoke, not a measurement: the GEMM-sandwich
+    # shape is ~1.6 TFLOP per timed call at the real size, far beyond a
+    # CI core's budget (the round-4 bare-LN chain was bandwidth-light;
+    # this one is deliberately matmul-bound — see docstring)
+    on_tpu = jax.default_backend() == "tpu"
+    N, H = (16 * 512, 1024) if on_tpu else (128, 64)
+    n_apps = 16 if on_tpu else 2
     ks = jax.random.split(jax.random.PRNGKey(_SALT), 4)
     x0 = jax.random.normal(ks[0], (N, H), jnp.float32)
     w0 = jnp.ones((H,), jnp.float32)
@@ -422,7 +428,7 @@ def bench_layer_norm():
             def loss(x, w, b, W1, W2):
                 xb = x.astype(jnp.bfloat16)
                 W1b, W2b = W1.astype(jnp.bfloat16), W2.astype(jnp.bfloat16)
-                for _ in range(16):
+                for _ in range(n_apps):
                     xb = block(xb, w, b, W1b, W2b)
                 return jnp.sum(xb.astype(jnp.float32) ** 2) / N
             dx, dw, db, dW1, dW2 = jax.grad(
@@ -714,7 +720,10 @@ def bench_long_context(seq=4096):
     dropout path is timed by the headline)."""
     from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 
-    B, NH, D, L = 1, 16, 64, 2
+    # L=1 at S>=8192: the composed arm materializes an L x 4.3 GB fp32
+    # score tensor through fwd+bwd; two layers would not leave room for
+    # the backward on the 16 GB chip
+    B, NH, D, L = 1, 16, 64, (1 if seq >= 8192 else 2)
     q0 = jax.random.normal(jax.random.PRNGKey(_SALT), (B, NH, seq, D),
                            jnp.float32)
 
@@ -761,7 +770,20 @@ def main():
     # baseline keeps remat (its fp32 activations would not fit
     # otherwise).
     batch, seq = (16, 512) if on_tpu else (2, 32)
-    dt_opt, dt_base, mfu = _measure(batch, seq, iters=8, remat=not on_tpu)
+    # one retry: a transient tunnel drop mid-headline (compile-service
+    # restarts were observed in round 5) must not zero out the whole
+    # recorded round
+    for attempt in (0, 1):
+        try:
+            dt_opt, dt_base, mfu = _measure(batch, seq, iters=8,
+                                            remat=not on_tpu)
+            break
+        except Exception as e:
+            if attempt:
+                raise
+            print(f"# headline attempt 0 failed ({e}); retrying",
+                  file=sys.stderr)
+            _reset()
     if on_tpu and "--all-shapes" in sys.argv:
         # secondary shape for comparison with earlier rounds' S=128 runs
         # (off by default: each extra config costs a slow fresh compile

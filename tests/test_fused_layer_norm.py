@@ -268,3 +268,31 @@ def test_multidim_wrong_trailing_raises():
         assert "trailing" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_ln_fwd_mode_knob_is_live(monkeypatch):
+    """APEX_TPU_LN_FWD is read per trace (round-5 review finding): the
+    A/B knob must switch the training-forward implementation when set
+    mid-process, not only at import. Observable: the all-Pallas fwd
+    pads+slices through the kernel path while the xla fwd is the plain
+    jnp formula — on oddly-shaped inputs both agree numerically, so the
+    check is on the traced jaxpr instead."""
+    from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+    x = jnp.ones((16, 64), jnp.float32)
+    w = jnp.ones((64,), jnp.float32)
+    b = jnp.zeros((64,), jnp.float32)
+
+    def n_pallas_calls():
+        return str(jax.make_jaxpr(
+            jax.grad(lambda x: jnp.sum(
+                fused_layer_norm_affine(x, w, b) ** 2)))(x)
+        ).count("pallas_call")
+
+    monkeypatch.setenv("APEX_TPU_LN_FWD", "pallas")
+    assert n_pallas_calls() == 2, (
+        "pallas mode: fwd AND bwd kernels in the grad jaxpr")
+    monkeypatch.setenv("APEX_TPU_LN_FWD", "xla")
+    assert n_pallas_calls() == 1, (
+        "xla mode: the forward is the jnp formula, so only the bwd "
+        "kernel remains")
