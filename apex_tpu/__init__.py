@@ -21,6 +21,9 @@ A from-scratch rebuild of the capability surface of NVIDIA Apex
   parallelism on a named device mesh (analog of ``apex/transformer/*``).
 - ``apex_tpu.contrib``    — xentropy, clip_grad, sparsity (ASP), multihead
   attention, distributed (ZeRO-style) optimizers (analog of ``apex/contrib``).
+- ``apex_tpu.serving``    — the inference leg (beyond the reference's
+  training-only surface): paged KV-cache, continuous-batching
+  prefill/decode engine, jit-stable sampling (docs/serving.md).
 
 Design stance (SURVEY.md §7): a functional JAX core with an apex-shaped API
 veneer — capability and knob parity with the reference, mesh/pjit-native
@@ -39,3 +42,4 @@ from apex_tpu import mlp  # noqa: F401
 from apex_tpu import reparameterization  # noqa: F401
 from apex_tpu import RNN  # noqa: F401
 from apex_tpu import fused_dense  # noqa: F401
+from apex_tpu import serving  # noqa: F401

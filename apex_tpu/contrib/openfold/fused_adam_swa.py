@@ -98,10 +98,19 @@ class FusedAdamSWA(FusedOptimizer):
         # SWA accumulation fused into the same pass: the averaged buffer
         # reads the freshly computed fp32 step output (still register-
         # resident in the fused chain), not a second trip through HBM.
+        # The FIRST real step (step == 1) copies the updated params
+        # instead of blending — the average starts AT the first updated
+        # parameters (torch AveragedModel / OpenFold AlphaFoldSWA
+        # first-capture semantics), never mixing in the init values
+        # (advisor r5 #4). step==1 is a traced condition, so a skipped
+        # (overflow) first step correctly retries the copy next step.
         d = jnp.float32(self.swa_decay_rate)
         src = new_master if self.master_weights else new_p
+        first = step == 1
         new_swa = jax.tree.map(
-            lambda s, p: d * s + (1.0 - d) * p.astype(jnp.float32),
+            lambda s, p: jnp.where(
+                first, p.astype(jnp.float32),
+                d * s + (1.0 - d) * p.astype(jnp.float32)),
             state.swa, src)
 
         new_state = SWAState(

@@ -44,18 +44,29 @@ class LayerNormSmallShapeOptImpl:
 
     @staticmethod
     def apply(x, normalized_shape, weight, bias, eps: float = 1e-5):
-        h = x.shape[-1]
+        shape = (tuple(int(d) for d in normalized_shape)
+                 if not isinstance(normalized_shape, int)
+                 else (int(normalized_shape),))
+        # the trailing dims must BE normalized_shape (mirroring
+        # fused_layer_norm's _check_trailing) — a divisibility test
+        # alone would silently normalize the wrong element grouping
+        # whenever a mismatched shape happens to divide x.size
+        # (advisor r5 #3)
+        k = len(shape)
+        if tuple(x.shape[-k:]) != shape:
+            raise ValueError(
+                f"normalized_shape {shape} does not match trailing dims "
+                f"{tuple(x.shape[-k:])} of input shape {tuple(x.shape)}")
         n = 1
-        for d in (normalized_shape if not isinstance(normalized_shape, int)
-                  else (normalized_shape,)):
-            n *= int(d)
-        if n != h and x.size % n == 0:
+        for d in shape:
+            n *= d
+        if n != x.shape[-1]:
             lead = x.shape
             y = fused_layer_norm_affine(
                 x.reshape(-1, n), weight.reshape(n), bias.reshape(n), eps)
             return y.reshape(lead)
-        return fused_layer_norm_affine(x, weight.reshape(h),
-                                       bias.reshape(h), eps)
+        return fused_layer_norm_affine(x, weight.reshape(n),
+                                       bias.reshape(n), eps)
 
 
 def softmax(x, mask: Optional[jax.Array] = None,

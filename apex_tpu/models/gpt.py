@@ -19,6 +19,12 @@ Attention backend selection (``attention_backend``):
 Both parallel backends require running inside ``shard_map`` with the
 context axis in scope (see ``examples/train_long_context.py`` for the
 mesh setup pattern).
+
+Serving: ``apply(..., kv_cache=...)`` (plus ``block_tables`` /
+``cache_positions`` / ``seq_lens``) switches to the paged-KV-cache
+inference path — prefill writes the prompt's K/V into cache blocks and
+runs the ordinary causal attention; a one-token call decodes against
+the block table. See :mod:`apex_tpu.serving` and docs/serving.md.
 """
 
 from __future__ import annotations
@@ -132,12 +138,73 @@ def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
 
 
 
+def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
+                      cache_positions, seq_lens):
+    """Serving attention against the paged KV-cache (flat (B, S, H)
+    projections in, flat context out, plus the updated cache).
+
+    Both serving modes write the freshly-projected K/V into the cache
+    blocks first, then attend:
+    - prefill (S > 1): the context IS the prompt just computed, so the
+      causal flash/composed path runs on the contiguous K/V directly
+      (no cache read) with padding tokens key-masked;
+    - decode (S == 1): single-query attention against the block table
+      via :func:`apex_tpu.ops.flash_attention.paged_decode_attention`.
+    The mode is static (S is a trace constant), so an engine compiles
+    exactly one program per shape — see docs/serving.md.
+    """
+    from apex_tpu.serving.kv_cache import KVCache, paged_write
+
+    B, S, h = q.shape
+    nh = cfg.num_heads
+    hd = h // nh
+    scale = 1.0 / (hd ** 0.5)
+    qh = q.reshape(B, S, nh, hd)
+    kh = k.reshape(B, S, nh, hd)
+    vh = v.reshape(B, S, nh, hd)
+
+    valid = cache_positions < seq_lens[:, None]
+    kv_cache = KVCache(
+        k=paged_write(kv_cache.k, layer, block_tables, cache_positions,
+                      kh, valid),
+        v=paged_write(kv_cache.v, layer, block_tables, cache_positions,
+                      vh, valid),
+    )
+
+    if S == 1:
+        from apex_tpu.ops.flash_attention import paged_decode_attention
+
+        ctx = paged_decode_attention(qh[:, 0], kv_cache.k[layer],
+                                     kv_cache.v[layer], block_tables,
+                                     seq_lens, scale)
+        return ctx.reshape(B, 1, h), kv_cache
+
+    key_mask = ~valid   # True = masked (the padding-mask convention)
+
+    def heads(t):
+        return t.transpose(0, 2, 1, 3)
+
+    if cfg.fused_kernels:
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(heads(qh), heads(kh), heads(vh), key_mask,
+                              True, scale)
+    else:
+        from apex_tpu.ops.flash_attention import mha_reference
+
+        ctx = mha_reference(heads(qh), heads(kh), heads(vh), key_mask,
+                            True, scale)
+    return ctx.transpose(0, 2, 1, 3).reshape(B, S, h), kv_cache
+
+
 class GPTBlock(nn.Module):
     cfg: GPTConfig
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, kv_cache=None,
+                 layer: int = 0, block_tables=None, cache_positions=None,
+                 seq_lens=None):
         cfg = self.cfg
         h, nh = cfg.hidden_size, cfg.num_heads
         hd = h // nh
@@ -145,19 +212,27 @@ class GPTBlock(nn.Module):
 
         # pre-LN attention: three flat (B, S, H) projections shared by
         # every backend (one param layout — checkpoints stay portable
-        # between flash / ring / Ulysses / composed configs)
+        # between flash / ring / Ulysses / composed / serving configs)
         y = _norm(cfg, "ln_1")(x)
         q = _dense(cfg, h, "attn_q")(y)
         k = _dense(cfg, h, "attn_k")(y)
         v = _dense(cfg, h, "attn_v")(y)
 
-        attn_drop = 0.0 if deterministic else cfg.dropout
+        # attention-probability dropout never applies on the serving
+        # path (inference); the block tail below is shared with training
+        attn_drop = (0.0 if deterministic or kv_cache is not None
+                     else cfg.dropout)
         # Ulysses ranks share local head indices for different global
         # heads (rank folded into the seed inside ulysses_attention);
         # ring ranks share the base seed and decorrelate via the global
         # block-pair hash inside ring_attention
         seed = (_dropout_seed(self, False) if attn_drop > 0.0 else None)
-        if cfg.attention_backend == "flash" and cfg.fused_kernels:
+        if kv_cache is not None:
+            ctx, kv_cache = _cached_attention(
+                cfg, q, k, v, kv_cache, layer, block_tables,
+                cache_positions, seq_lens)
+            ctx = ctx.astype(cfg.dtype)
+        elif cfg.attention_backend == "flash" and cfg.fused_kernels:
             from apex_tpu.ops.flash_attention import flash_attention_bsh
 
             # transpose-free (B, S, H) kernels in the single-tile
@@ -199,6 +274,8 @@ class GPTBlock(nn.Module):
         y = _TPDropout(cfg.dropout, fused=cfg.fused_kernels,
                        fold_axes=ctx_axes)(
             y, deterministic=deterministic)
+        if kv_cache is not None:
+            return x + y, kv_cache
         return x + y
 
 
@@ -211,7 +288,8 @@ class GPTModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
-                 position_offset=0):
+                 position_offset=0, kv_cache=None, block_tables=None,
+                 cache_positions=None, seq_lens=None):
         cfg = self.cfg
         B, S_local = input_ids.shape
         wte = self.param("wte", _INIT, (cfg.vocab_size, cfg.hidden_size),
@@ -219,6 +297,32 @@ class GPTModel(nn.Module):
         wpe = self.param("wpe", _INIT,
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          jnp.float32)
+        if kv_cache is not None:
+            # Serving path (paged KV-cache): single-device attention only
+            # — the context-parallel backends re-shard the sequence axis,
+            # which has no meaning for a one-token decode step. Position
+            # embeddings are gathered per token (each sequence sits at
+            # its own offset), not dynamic-sliced at a shared offset.
+            if cfg.attention_backend in ("ring", "ulysses"):
+                raise ValueError(
+                    "kv_cache serving does not support the "
+                    f"{cfg.attention_backend!r} context-parallel backend; "
+                    "use attention_backend='flash'")
+            if cfg.num_experts > 0:
+                raise NotImplementedError(
+                    "kv_cache serving does not support MoE blocks yet")
+            if (block_tables is None or cache_positions is None
+                    or seq_lens is None):
+                raise ValueError(
+                    "kv_cache requires block_tables, cache_positions, "
+                    "and seq_lens")
+            pos = jnp.take(wpe, cache_positions, axis=0)   # [B, S, H]
+            x = (wte[input_ids] + pos).astype(cfg.dtype)
+            for i in range(cfg.num_layers):
+                x, kv_cache = GPTBlock(cfg, False, name=f"h_{i}")(
+                    x, deterministic, kv_cache, i, block_tables,
+                    cache_positions, seq_lens)
+            return _norm(cfg, "ln_f")(x), wte, kv_cache
         if cfg.attention_backend in ("ring", "ulysses"):
             # sequence-sharded: this shard's global positions. Validate
             # the table covers the GLOBAL sequence — dynamic_slice would
@@ -259,13 +363,28 @@ class GPTModel(nn.Module):
 
 
 class GPTLMHeadModel(nn.Module):
-    """GPT with the weight-tied LM head (logits = hidden @ wte^T)."""
+    """GPT with the weight-tied LM head (logits = hidden @ wte^T).
+
+    With ``kv_cache=`` (plus ``block_tables``/``cache_positions``/
+    ``seq_lens``, see :class:`GPTModel`) the call runs the serving path
+    and returns ``(logits, new_kv_cache)`` instead of bare logits —
+    the hook :class:`apex_tpu.serving.engine.InferenceEngine` drives.
+    """
 
     cfg: GPTConfig
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
-                 position_offset=0):
+                 position_offset=0, kv_cache=None, block_tables=None,
+                 cache_positions=None, seq_lens=None):
+        if kv_cache is not None:
+            x, wte, new_cache = GPTModel(self.cfg, name="transformer")(
+                input_ids, deterministic, position_offset,
+                kv_cache=kv_cache, block_tables=block_tables,
+                cache_positions=cache_positions, seq_lens=seq_lens)
+            logits = jnp.einsum("bsh,vh->bsv", x, wte.astype(x.dtype),
+                                preferred_element_type=jnp.float32)
+            return logits, new_cache
         x, wte = GPTModel(self.cfg, name="transformer")(
             input_ids, deterministic, position_offset)
         return jnp.einsum("bsh,vh->bsv", x, wte.astype(x.dtype),

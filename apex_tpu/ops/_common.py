@@ -45,6 +45,16 @@ def mix_seed(seed, n):
     return (mixed & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
 
 
+def _vma_of(x):
+    """The varying-axes set of a value, or empty on JAX versions without
+    ``jax.typeof``/vma tracking (pre-0.6 releases: shard_map there has no
+    vma checking, so "varies over no axes" is the correct answer)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(x), "vma", ()))
+
+
 def use_jnp_fallback(*arrays) -> bool:
     """True when the Pallas interpreter cannot be used: non-TPU backend AND
     inputs varying over shard_map axes (this JAX version's HLO interpreter
@@ -52,7 +62,7 @@ def use_jnp_fallback(*arrays) -> bool:
     the identical formulas; real TPU always takes the compiled kernels."""
     if jax.default_backend() == "tpu":
         return False
-    return any(frozenset(getattr(jax.typeof(a), "vma", ())) for a in arrays if a is not None)
+    return any(_vma_of(a) for a in arrays if a is not None)
 
 
 def match_vma(cotangent, primal_example):
@@ -64,8 +74,8 @@ def match_vma(cotangent, primal_example):
     (e.g. params replicated across ``data`` receiving data-sharded
     batch gradients), the bwd rule must psum over the extra axes itself.
     """
-    want = frozenset(getattr(jax.typeof(primal_example), "vma", ()))
-    have = frozenset(getattr(jax.typeof(cotangent), "vma", ()))
+    want = _vma_of(primal_example)
+    have = _vma_of(cotangent)
     extra = have - want
     if extra:
         cotangent = jax.lax.psum(cotangent, tuple(sorted(extra)))
@@ -79,7 +89,7 @@ def out_struct(shape, dtype, *like):
     accepted and ignored."""
     vma = frozenset()
     for r in like:
-        vma |= frozenset(getattr(jax.typeof(r), "vma", ()))
+        vma |= _vma_of(r)
     try:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except TypeError:  # older jax without the vma kwarg

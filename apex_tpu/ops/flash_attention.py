@@ -1279,3 +1279,60 @@ def _bsh_vjp_bwd(num_heads, causal, scale, dropout_rate, res, g):
 
 
 _flash_bsh_core.defvjp(_bsh_vjp_fwd, _bsh_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention (single-query attention against a block table)
+# ---------------------------------------------------------------------------
+#
+# The serving decode step: each sequence contributes ONE query token that
+# attends over its entire cached context, which lives scattered across
+# the paged KV pool (apex_tpu.serving.kv_cache) rather than in a
+# contiguous (B, S, H, D) tensor. The score tensor is (B, H, 1, ctx) —
+# there is no S_q dimension to tile, no online-softmax recurrence to
+# carry, and no backward pass (inference only), so the flash machinery
+# above buys nothing here; what matters is the GATHER (block table ->
+# pool rows) and the fp32 masked softmax, which XLA fuses into a
+# bandwidth-bound gather + GEMV chain on both CPU and TPU. Masking
+# follows this file's conventions: fp32 accumulation via
+# preferred_element_type, the finite FILL for dead positions (a fully
+# empty context — an inactive batch slot — degrades to a uniform read of
+# zero-initialized pool rows instead of NaN).
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
+                           scale: float = 1.0):
+    """Single-query attention against the paged KV pool.
+
+    Args:
+      q: ``[B, H, D]`` — one query token per sequence (the token being
+        decoded, whose K/V must already be written into the pool).
+      k_pages, v_pages: ``[num_blocks, block_size, H, D]`` — ONE layer's
+        block pool (callers index the stacked ``[L, ...]`` cache).
+      block_tables: ``[B, max_blocks_per_seq]`` int32 block ids in
+        sequence order; entries past a sequence's allocation may be any
+        value (out-of-bounds ids are clipped into the pool and the
+        positions masked by ``context_lens``).
+      context_lens: ``[B]`` int32 — valid tokens per sequence INCLUDING
+        the current one.
+      scale: softmax temperature (typically ``1/sqrt(D)``).
+
+    Returns ``[B, H, D]`` in ``q.dtype``.
+    """
+    B, H, D = q.shape
+    N, bs = k_pages.shape[0], k_pages.shape[1]
+    tbl = jnp.minimum(block_tables, N - 1)
+    k = k_pages[tbl].reshape(B, -1, H, D)        # [B, ctx_max, H, D]
+    v = v_pages[tbl].reshape(B, -1, H, D)
+    ctx_max = k.shape[1]
+
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, ctx_max), 1)
+    dead = pos >= context_lens[:, None]          # [B, ctx_max]
+    s = jnp.where(dead[:, None, :], FILL, s)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

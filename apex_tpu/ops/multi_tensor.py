@@ -68,6 +68,14 @@ def stochastic_round(x: Array, dtype, key) -> Array:
     xi = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     trunc = jax.lax.bitcast_convert_type(
         (xi + bits) & jnp.uint32(0xFFFF0000), jnp.float32)
+    # The uint32 add can carry into the exponent: finite values in the
+    # last bf16 ULP below bf16-max (or between bf16-max and fp32-max)
+    # would round to +/-inf, and an inf written into an EMA moment is
+    # sticky — it permanently zeroes that parameter's updates (ADVICE
+    # r5 #1). Clamp to the finite bf16 range; saturation at the max is
+    # the standard round-to-nearest overflow behavior for these values.
+    bf16_max = jnp.float32(jnp.finfo(jnp.bfloat16).max)
+    trunc = jnp.clip(trunc, -bf16_max, bf16_max)
     return jnp.where(jnp.isfinite(x), trunc, x).astype(dtype)
 
 

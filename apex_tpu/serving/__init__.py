@@ -1,0 +1,33 @@
+"""apex_tpu.serving — the inference leg of the stack.
+
+Paged KV-cache (:mod:`~apex_tpu.serving.kv_cache`), continuous-batching
+prefill/decode engine (:mod:`~apex_tpu.serving.engine`), and jit-stable
+sampling (:mod:`~apex_tpu.serving.sampling`); design notes in
+docs/serving.md. The training-side capability surface (amp dtype
+policy, the flash-attention kernel family, the GPT/BERT models) is
+reused, not duplicated: the cache stores in the amp compute dtype, the
+decode path lives in :mod:`apex_tpu.ops.flash_attention`, and the model
+hook is ``GPTLMHeadModel.apply(..., kv_cache=...)``.
+"""
+
+from apex_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    InferenceEngine,
+    Request,
+)
+from apex_tpu.serving.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    CacheOutOfBlocks,
+    KVCache,
+    blocks_needed,
+    default_kv_dtype,
+    defragment,
+    device_block_table,
+    gather_blocks,
+    gather_kv,
+    paged_write,
+)
+from apex_tpu.serving.sampling import (  # noqa: F401
+    SamplingParams,
+    sample_tokens,
+)

@@ -1,0 +1,356 @@
+"""Continuous-batching inference engine: prefill/decode split over the
+paged KV-cache, with a fixed-shape scheduler.
+
+The Orca/vLLM serving loop (PAPERS.md) restated for XLA, where a shape
+change means a recompile and a recompile means a multi-second stall
+mid-traffic. The engine therefore holds a **two-program contract**:
+
+- ``prefill``: one request at a time at the fixed shape
+  ``[1, max_prefill_len]`` — prompt tokens right-padded, causal
+  attention with the padding key-masked, K/V written into freshly
+  allocated cache blocks, and the FIRST generated token sampled from
+  the last real position's logits.
+- ``decode``: ALL active slots at once at the fixed shape
+  ``[max_batch, 1]`` — each slot's last token attends against its block
+  table, one token sampled per slot. Inactive slots ride along as
+  masked lanes (their block-table rows point out of bounds, so their
+  writes drop and their outputs are ignored).
+
+Everything that varies between steps — which slots are live, block
+tables, context lengths, sampling knobs — varies as *array values*, so
+XLA compiles exactly two programs for the lifetime of the engine
+(``stats()["prefill_compilations"] == 1`` and likewise for decode; the
+acceptance test pins this).
+
+Scheduling (host-side, between jitted steps): admission fills free
+decode slots from the FIFO waiting queue whenever the request's
+WORST-CASE block count (prompt + full ``max_new_tokens`` budget) fits
+in the free pool net of what already-active slots may still claim
+(continuous batching — new requests join mid-flight, nothing waits for
+a "batch" to form); eviction frees a slot's blocks the moment it
+finishes (EOS sampled, or ``max_new_tokens`` reached). The worst-case
+reservation guarantees a decode-time block allocation can never fail;
+preemption/swapping (which would allow optimistic admission) is future
+work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving.kv_cache import (
+    BlockAllocator,
+    CacheOutOfBlocks,
+    KVCache,
+    blocks_needed,
+    device_block_table,
+)
+from apex_tpu.serving.sampling import SamplingParams, sample_tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. ``prompt`` is a token-id sequence;
+    generation runs until EOS (if ``eos_token_id`` is set) or
+    ``max_new_tokens``, whichever comes first."""
+
+    uid: str
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    sampling: SamplingParams = SamplingParams()
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_batch: int = 8            # decode slots
+    block_size: int = 16
+    num_blocks: int = 256         # pool size (per layer)
+    max_prefill_len: int = 64     # THE prefill shape; prompts must fit
+    max_seq_len: int = 256        # prompt + generation cap per sequence
+    kv_dtype: Optional[object] = None   # None = follow the amp policy
+    # Donate the cache pool to the jitted steps so XLA updates it in
+    # place instead of materializing a second pool + copy per step
+    # (double peak HBM and a full-pool write otherwise). Default off:
+    # the axon TPU runtime rejects donated buffers at run time (see
+    # bench.py's --donate probe history) and older CPU jaxlibs ignore
+    # donation with a warning; flip on for runtimes that support it.
+    donate_cache: bool = False
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side state of one active decode lane."""
+
+    request: Request
+    context_len: int              # tokens currently in the cache
+    blocks: List[int]             # owned block ids, sequence order
+    generated: List[int]
+    last_token: int
+
+
+class InferenceEngine:
+    """Drives a :class:`~apex_tpu.models.gpt.GPTLMHeadModel` (or any
+    model exposing the same ``kv_cache=`` apply contract) through
+    continuous-batching generation.
+
+    Usage::
+
+        engine = InferenceEngine(model, params, EngineConfig(...))
+        engine.add_request(Request("a", prompt, max_new_tokens=32))
+        outputs = engine.run()          # {"a": [tok, tok, ...]}
+
+    ``add_request`` may be called at any time, including between
+    ``step()`` calls while other requests are mid-generation — that is
+    the continuous-batching point.
+    """
+
+    def __init__(self, model, params, config: EngineConfig):
+        cfg = model.cfg
+        self.model = model
+        self.params = params
+        self.config = config
+        if config.max_prefill_len > config.max_seq_len:
+            raise ValueError("max_prefill_len exceeds max_seq_len")
+        if config.max_seq_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_seq_len ({config.max_seq_len}) exceeds the model's "
+                f"max_position_embeddings ({cfg.max_position_embeddings})")
+        self.max_blocks_per_seq = blocks_needed(config.max_seq_len,
+                                                config.block_size)
+        self.cache = KVCache.create(
+            cfg.num_layers, config.num_blocks, config.block_size,
+            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+            dtype=config.kv_dtype)
+        self.allocator = BlockAllocator(config.num_blocks)
+        self.slots: List[Optional[_Slot]] = [None] * config.max_batch
+        self.waiting: deque = deque()
+        self.finished: Dict[str, List[int]] = {}
+        self._key = jax.random.PRNGKey(config.seed)
+        self._step_count = 0
+        self._num_prefills = 0
+        self._num_decode_steps = 0
+        # the two programs; anything else jitted here would break the
+        # two-compilation contract the tests pin. Arg 1 is the cache
+        # pool in both signatures (donated when the runtime allows).
+        donate = (1,) if config.donate_cache else ()
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate)
+        self._decode = jax.jit(self._decode_impl, donate_argnums=donate)
+
+    # -- the two jitted programs ------------------------------------------
+
+    def _prefill_impl(self, params, cache, ids, seq_len, table, key,
+                      temp, top_k, top_p):
+        P = ids.shape[1]
+        positions = jnp.arange(P, dtype=jnp.int32)[None]
+        logits, cache = self.model.apply(
+            params, ids, deterministic=True, kv_cache=cache,
+            block_tables=table, cache_positions=positions,
+            seq_lens=seq_len)
+        last = jnp.take_along_axis(
+            logits, (seq_len - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
+        tok = sample_tokens(last, key, temp, top_k, top_p)
+        return cache, tok
+
+    def _decode_impl(self, params, cache, tokens, tables, context_lens,
+                     key, temp, top_k, top_p):
+        logits, cache = self.model.apply(
+            params, tokens, deterministic=True, kv_cache=cache,
+            block_tables=tables,
+            cache_positions=context_lens[:, None],
+            seq_lens=context_lens + 1)
+        tok = sample_tokens(logits[:, 0], key, temp, top_k, top_p)
+        return cache, tok
+
+    # -- host-side scheduling ---------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        n = len(request.prompt)
+        if n == 0:
+            raise ValueError(f"request {request.uid!r}: empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.uid!r}: max_new_tokens must be >= 1 "
+                f"(got {request.max_new_tokens}); prefill always samples "
+                "the first token")
+        if n > self.config.max_prefill_len:
+            raise ValueError(
+                f"request {request.uid!r}: prompt length {n} exceeds "
+                f"max_prefill_len ({self.config.max_prefill_len})")
+        if n + request.max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"request {request.uid!r}: prompt + max_new_tokens "
+                f"({n} + {request.max_new_tokens}) exceeds max_seq_len "
+                f"({self.config.max_seq_len})")
+        request.sampling.validate()
+        self.waiting.append(request)
+
+    def _next_key(self):
+        self._step_count += 1
+        return jax.random.fold_in(self._key, self._step_count)
+
+    def _host_tables(self) -> np.ndarray:
+        t = np.full((self.config.max_batch, self.max_blocks_per_seq), -1,
+                    np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                t[i, : len(slot.blocks)] = slot.blocks
+        return t
+
+    def _sampling_arrays(self, per_slot):
+        temp = np.zeros(len(per_slot), np.float32)
+        top_k = np.zeros(len(per_slot), np.int32)
+        top_p = np.ones(len(per_slot), np.float32)
+        for i, sp in enumerate(per_slot):
+            if sp is not None:
+                temp[i], top_k[i], top_p[i] = (sp.temperature, sp.top_k,
+                                               sp.top_p)
+        return (jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+
+    def _finish(self, idx: int) -> None:
+        slot = self.slots[idx]
+        self.allocator.free(slot.blocks)
+        self.finished[slot.request.uid] = slot.generated
+        self.slots[idx] = None
+
+    def _record_token(self, idx: int, token: int) -> None:
+        """Append a sampled token to a slot, finishing on EOS/max-len."""
+        slot = self.slots[idx]
+        slot.generated.append(token)
+        slot.last_token = token
+        req = slot.request
+        if ((req.eos_token_id is not None and token == req.eos_token_id)
+                or len(slot.generated) >= req.max_new_tokens):
+            self._finish(idx)
+
+    def _worst_case_blocks(self, req: Request) -> int:
+        return blocks_needed(len(req.prompt) + req.max_new_tokens,
+                             self.config.block_size)
+
+    def _reserved_outstanding(self) -> int:
+        """Blocks the ACTIVE slots may still allocate before finishing
+        (their worst case minus what they already own). Admission
+        reserves against this so a decode-time ``alloc`` can never
+        fail — without preemption, over-commit would abort every
+        in-flight generation mid-step."""
+        total = 0
+        for s in self.slots:
+            if s is not None:
+                total += max(0, self._worst_case_blocks(s.request)
+                             - len(s.blocks))
+        return total
+
+    def _admit(self) -> int:
+        """Move waiting requests into free slots while capacity lasts:
+        the request's WORST-CASE block count (prompt + full generation
+        budget) must fit in the unreserved free pool. Returns the
+        number of requests admitted (a prefilled request may FINISH
+        during admission — max_new_tokens=1, or EOS on the first
+        sampled token — so progress cannot be read off the slots)."""
+        admitted = 0
+        for idx in range(self.config.max_batch):
+            if not self.waiting or self.slots[idx] is not None:
+                continue
+            req = self.waiting[0]
+            free_unreserved = (self.allocator.num_free
+                               - self._reserved_outstanding())
+            if self._worst_case_blocks(req) > free_unreserved:
+                break   # FIFO: don't let a small request starve the head
+            need = blocks_needed(len(req.prompt), self.config.block_size)
+            self.waiting.popleft()
+            blocks = self.allocator.alloc(need)
+            n = len(req.prompt)
+            P = self.config.max_prefill_len
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :n] = np.asarray(req.prompt, np.int32)
+            table = np.full((1, self.max_blocks_per_seq), -1, np.int32)
+            table[0, : len(blocks)] = blocks
+            temp, top_k, top_p = self._sampling_arrays([req.sampling])
+            self.cache, tok = self._prefill(
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.asarray([n], jnp.int32),
+                device_block_table(table, self.config.num_blocks),
+                self._next_key(), temp, top_k, top_p)
+            self._num_prefills += 1
+            self.slots[idx] = _Slot(request=req, context_len=n,
+                                    blocks=blocks, generated=[],
+                                    last_token=0)
+            self._record_token(idx, int(tok[0]))
+            admitted += 1
+        return admitted
+
+    def _ensure_decode_blocks(self) -> None:
+        """Each active slot is about to write K/V at position
+        ``context_len`` — allocate that block if the table doesn't
+        cover it yet."""
+        for slot in self.slots:
+            if slot is None:
+                continue
+            need = blocks_needed(slot.context_len + 1,
+                                 self.config.block_size)
+            while len(slot.blocks) < need:
+                slot.blocks.extend(self.allocator.alloc(1))
+
+    def step(self) -> None:
+        """One scheduler tick: admit, then one decode step for every
+        active slot (if any)."""
+        admitted = self._admit()
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            if self.waiting and not admitted:
+                # zero live sequences means nothing will ever free a
+                # block — the queue head can never be admitted (the
+                # pool is undersized for it). Raise, don't spin.
+                req = self.waiting[0]
+                raise CacheOutOfBlocks(
+                    f"request {req.uid!r} needs "
+                    f"{self._worst_case_blocks(req)} blocks worst-case "
+                    f"but only {self.allocator.num_free} of "
+                    f"{self.allocator.num_blocks} can ever be free")
+            return
+        self._ensure_decode_blocks()
+        B = self.config.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        for i in active:
+            tokens[i, 0] = self.slots[i].last_token
+            ctx[i] = self.slots[i].context_len
+        temp, top_k, top_p = self._sampling_arrays(
+            [s.request.sampling if s is not None else None
+             for s in self.slots])
+        self.cache, toks = self._decode(
+            self.params, self.cache, jnp.asarray(tokens),
+            device_block_table(self._host_tables(),
+                               self.config.num_blocks),
+            jnp.asarray(ctx), self._next_key(), temp, top_k, top_p)
+        self._num_decode_steps += 1
+        toks = np.asarray(toks)
+        for i in active:
+            self.slots[i].context_len += 1
+            self._record_token(i, int(toks[i]))
+
+    def run(self) -> Dict[str, List[int]]:
+        """Drain: step until every queued and active request finishes.
+        Returns ``{uid: generated_token_ids}``."""
+        while self.waiting or any(s is not None for s in self.slots):
+            self.step()
+        out, self.finished = self.finished, {}
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "prefill_compilations": self._prefill._cache_size(),
+            "decode_compilations": self._decode._cache_size(),
+            "num_prefills": self._num_prefills,
+            "num_decode_steps": self._num_decode_steps,
+            "active_slots": sum(s is not None for s in self.slots),
+            "waiting": len(self.waiting),
+            "cache_utilization": self.allocator.utilization,
+        }

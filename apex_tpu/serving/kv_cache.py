@@ -1,0 +1,231 @@
+"""Paged KV-cache: fixed-shape block pools + host-side block accounting.
+
+The serving-side analog of vLLM's PagedAttention cache (PAPERS.md) on
+XLA's terms: device memory is a fixed pool of ``num_blocks`` blocks per
+layer, laid out ``[num_layers, num_blocks, block_size, num_heads,
+head_dim]``, and a sequence owns a *block table* — the ordered list of
+block ids holding its tokens. Every jitted program sees only fixed
+shapes (the pool, a ``[B, max_blocks_per_seq]`` int32 table, and
+``[B]`` lengths), so admission, eviction, and sequence growth never
+trigger recompilation: the continuous-batching engine swaps table
+*values*, not shapes.
+
+Division of labor (the load-bearing design point):
+
+- **Device side** (jit-stable, pure): :func:`paged_write` scatters new
+  K/V into blocks, :func:`gather_kv` reads a sequence back out, and
+  :func:`gather_blocks` applies a defrag permutation. All take the
+  pool + int32 indices; invalid slots are routed to an out-of-bounds
+  block id and dropped by the scatter (``mode="drop"``), so inactive
+  batch slots cost nothing and write nowhere.
+- **Host side** (Python, between steps): :class:`BlockAllocator` is a
+  free-list over block ids — allocation, free, utilization — and
+  :func:`defragment` compacts live blocks to the low indices (returns
+  the gather permutation + rewritten tables). The scheduler consults
+  the allocator; the device never sees it.
+
+Storage dtype rides the existing amp policy: :func:`default_kv_dtype`
+returns the active ``amp.initialize`` handle's compute dtype (bf16 for
+O1-O3, fp32 for O0) unless overridden — the cache is activation-class
+state, so it follows the activation precision, not the master-weight
+precision.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def default_kv_dtype(dtype=None):
+    """Resolve the KV-storage dtype through the amp policy: an explicit
+    ``dtype`` wins; otherwise the last ``amp.initialize`` handle's
+    compute dtype (bf16 under O1-O3); fp32 when amp was never set up."""
+    if dtype is not None:
+        return jnp.dtype(dtype)
+    from apex_tpu.amp import _amp_state
+
+    handle = _amp_state._amp_state.handle
+    if handle is not None:
+        return jnp.dtype(handle.properties.compute_dtype)
+    return jnp.dtype(jnp.float32)
+
+
+class KVCache(NamedTuple):
+    """The device-side block pools (a pytree of two arrays).
+
+    ``k`` / ``v``: ``[num_layers, num_blocks, block_size, num_heads,
+    head_dim]``. The pool is allocated once at engine start and updated
+    functionally (scatter in, new pytree out); the layout keeps the
+    ``(num_heads * head_dim)`` product in the trailing dims so a block
+    row is lane-tileable on TPU.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_heads(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    @classmethod
+    def create(cls, num_layers: int, num_blocks: int, block_size: int,
+               num_heads: int, head_dim: int, dtype=None) -> "KVCache":
+        dt = default_kv_dtype(dtype)
+        shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
+        return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+class CacheOutOfBlocks(RuntimeError):
+    """The free list cannot serve an allocation (admission should have
+    been throttled, or the pool is fragmented — see :func:`defragment`)."""
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's block ids.
+
+    Lives entirely outside jit: the scheduler calls ``alloc``/``free``
+    between steps and writes the resulting ids into host block tables,
+    which are shipped to the device as plain int32 inputs.
+    """
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        # pop() from the end serves ascending ids first — keeps early
+        # allocations compact, which makes defrag cheap in the common case
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of pool blocks currently owned by live sequences."""
+        return self.num_used / max(self.num_blocks, 1)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise CacheOutOfBlocks(
+                f"requested {n} blocks, {len(self._free)} free of "
+                f"{self.num_blocks}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids: Sequence[int]) -> None:
+        for b in ids:
+            if not (0 <= b < self.num_blocks):
+                raise ValueError(f"block id {b} out of range")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+    def reset(self) -> None:
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+
+
+def blocks_needed(num_tokens: int, block_size: int) -> int:
+    return -(-int(num_tokens) // int(block_size))
+
+
+def device_block_table(host_tables: np.ndarray, num_blocks: int) -> jax.Array:
+    """Host tables use -1 for unallocated entries; the device convention
+    is ``num_blocks`` (one past the pool) so scatters drop and gathers
+    clip into already-masked positions."""
+    t = np.asarray(host_tables, np.int32)
+    return jnp.asarray(np.where(t >= 0, t, num_blocks), jnp.int32)
+
+
+def paged_write(pages: jax.Array, layer: int, block_tables: jax.Array,
+                positions: jax.Array, values: jax.Array,
+                valid: jax.Array) -> jax.Array:
+    """Scatter per-token K or V into one layer's blocks.
+
+    Args:
+      pages: the full pool ``[L, N, bs, H, D]``.
+      layer: static layer index.
+      block_tables: ``[B, max_blocks_per_seq]`` int32 (device
+        convention: out-of-bounds id for unallocated entries).
+      positions: ``[B, S]`` absolute token positions within each
+        sequence.
+      values: ``[B, S, H, D]`` the tokens' K or V heads.
+      valid: ``[B, S]`` bool; False routes the write out of bounds,
+        where ``mode="drop"`` discards it (padding tokens, inactive
+        decode slots).
+    """
+    N, bs = pages.shape[1], pages.shape[2]
+    page = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    page = jnp.where(valid, page, N)
+    off = positions % bs
+    return pages.at[layer, page, off].set(
+        values.astype(pages.dtype), mode="drop")
+
+
+def gather_kv(pages: jax.Array, layer: int,
+              block_tables: jax.Array) -> jax.Array:
+    """Read every sequence's cached tokens back out of one layer's pool:
+    ``[B, max_blocks_per_seq * bs, H, D]`` in position order. Entries
+    past a sequence's length hold stale pool contents and MUST be
+    masked by the consumer (the decode attention masks on length)."""
+    N = pages.shape[1]
+    tbl = jnp.minimum(block_tables, N - 1)  # clip OOB ids into the pool
+    out = pages[layer][tbl]                 # [B, M, bs, H, D]
+    B, M, bs, H, D = out.shape
+    return out.reshape(B, M * bs, H, D)
+
+
+def gather_blocks(cache: KVCache, perm: jax.Array) -> KVCache:
+    """Apply a block permutation to the pool (``new[i] = old[perm[i]]``)
+    — the device half of :func:`defragment`."""
+    return KVCache(k=cache.k[:, perm], v=cache.v[:, perm])
+
+
+def defragment(cache: KVCache, allocator: BlockAllocator,
+               host_tables: np.ndarray):
+    """Compact live blocks to the low pool indices.
+
+    Long-running continuous batching interleaves allocations from many
+    sequences, so frees leave the pool checkerboarded; compaction
+    restores a contiguous free region (and, on hardware with block-
+    granular paging tricks, locality). Returns ``(new_cache,
+    new_host_tables)`` and rewrites the allocator's free list. The
+    device shuffle is one gather over the pool — call it rarely, from
+    a maintenance point, never inside the per-step loop.
+    """
+    tables = np.array(host_tables, np.int32, copy=True)
+    live = np.unique(tables[tables >= 0])
+    mapping = {int(old): new for new, old in enumerate(live)}
+    perm = np.arange(cache.num_blocks, dtype=np.int32)
+    perm[: len(live)] = live
+    # the remaining slots get the displaced (dead) blocks, keeping perm
+    # a true permutation so no block id aliases another
+    dead = np.setdiff1d(np.arange(cache.num_blocks, dtype=np.int32), live,
+                        assume_unique=False)
+    perm[len(live):] = dead
+    for idx, old in np.ndenumerate(tables):
+        if old >= 0:
+            tables[idx] = mapping[int(old)]
+    allocator._free = list(range(cache.num_blocks - 1, len(live) - 1, -1))
+    return gather_blocks(cache, jnp.asarray(perm)), tables

@@ -22,11 +22,14 @@ caller does.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Dict
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
                 "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
-                "s8": 1, "u8": 1, "pred": 1}
+                "s8": 1, "u8": 1, "pred": 1,
+                # complex payloads (FFT-adjacent collectives)
+                "c64": 8, "c128": 16}
 
 # HLO op mnemonics of the cross-device collective set (async variants
 # appear as <op>-start / <op>-done; only -start carries the shapes we
@@ -45,15 +48,30 @@ _LINE_RE = re.compile(
     + r")(?:-start)?\(")
 
 
+def _dtype_bytes(dt: str) -> int:
+    """Element size for an HLO dtype mnemonic. Unknown dtypes WARN and
+    fall back to 4 bytes — a silent default miscounted c64/c128/f8
+    payloads (advisor r5 #2); the warning makes a new XLA dtype a
+    visible one-line fix instead of a quietly wrong audit."""
+    if dt in _DTYPE_BYTES:
+        return _DTYPE_BYTES[dt]
+    if dt.startswith("f8") or dt.startswith("f4"):
+        return 1  # every f8 flavor (e4m3/e5m2/...) is one byte; f4 sub-byte
+    warnings.warn(
+        f"hlo_audit: unknown HLO dtype {dt!r}; assuming 4 bytes — add it "
+        f"to _DTYPE_BYTES for exact accounting", stacklevel=3)
+    return 4
+
+
 def _shape_bytes(shapes_text: str) -> int:
     total = 0
-    for dt, dims in re.findall(r"([a-z]+\d+|pred)\[([\d,]*)\]",
+    for dt, dims in re.findall(r"\b([a-z][a-z0-9]*)\[([\d,]*)\]",
                                shapes_text):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES.get(dt, 4)
+        total += n * _dtype_bytes(dt)
     return total
 
 

@@ -757,8 +757,115 @@ def bench_long_context(seq=4096):
     }
 
 
-def main():
+def _backend_with_cpu_fallback():
+    """First touch of the JAX backend, with a CPU fallback: plugin init
+    can raise at first use (BENCH_r05: the TPU plugin came up
+    ``UNAVAILABLE`` and the whole run died with rc=1, recording
+    nothing). A crashed round is strictly worse than a CPU-smoke round
+    — fall back to ``JAX_PLATFORMS=cpu`` so the bench trajectory keeps
+    recording (the off-TPU metric names already mark smoke runs)."""
+    try:
+        return jax.default_backend()
+    except Exception as e:
+        print(f"# backend init failed ({type(e).__name__}: {e}); "
+              "falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return jax.default_backend()
+
+
+def bench_serving():
+    """Serving section (round 6): the continuous-batching engine
+    (apex_tpu.serving) driving GPT decode with the paged KV-cache —
+    prefill tokens/s, decode steps/s (one step = one token for every
+    active slot), and peak cache-slot utilization. Two phases so the
+    numbers don't contaminate each other: a max_new_tokens=1 drain is
+    ~pure prefill; a drain with every slot busy is decode-dominated.
+    On TPU this runs a GPT-2-small-class config; off-TPU the tiny smoke
+    config (flow check, metric named accordingly)."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
+                                  SamplingParams)
+
     on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ecfg = EngineConfig(max_batch=16, block_size=32, num_blocks=512,
+                            max_prefill_len=256, max_seq_len=512,
+                            kv_dtype=jnp.bfloat16)
+        n_req, max_new, prompt_len = 16, 64, 128
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ecfg = EngineConfig(max_batch=4, block_size=8, num_blocks=64,
+                            max_prefill_len=16, max_seq_len=48)
+        n_req, max_new, prompt_len = 6, 8, 12
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.RandomState(_SALT)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))
+    engine = InferenceEngine(model, params, ecfg)
+
+    def requests(tag, new_tokens):
+        return [
+            Request(uid=f"{tag}-{i}",
+                    prompt=list(rng.randint(0, cfg.vocab_size, prompt_len)),
+                    max_new_tokens=new_tokens,
+                    sampling=SamplingParams(temperature=1.0, top_k=40))
+            for i in range(n_req)
+        ]
+
+    # warmup: compile the two programs (prefill + decode)
+    for r in requests("warm", 2):
+        engine.add_request(r)
+    engine.run()
+
+    # phase 1 — prefill throughput (max_new_tokens=1: no decode steps)
+    reqs = requests("pre", 1)
+    tokens = sum(len(r.prompt) for r in reqs)
+    t0 = time.perf_counter()
+    for r in reqs:
+        engine.add_request(r)
+    engine.run()
+    prefill_tok_s = tokens / max(time.perf_counter() - t0, 1e-9)
+
+    # phase 2 — decode throughput + peak slot utilization
+    s0 = engine.stats()
+    util_peak = 0.0
+    t0 = time.perf_counter()
+    for r in requests("dec", max_new):
+        engine.add_request(r)
+    while engine.waiting or any(s is not None for s in engine.slots):
+        engine.step()
+        util_peak = max(util_peak, engine.allocator.utilization)
+    dt = time.perf_counter() - t0
+    decode_steps = engine.stats()["num_decode_steps"] - s0["num_decode_steps"]
+    stats = engine.stats()
+    print(f"# serving: prefill {prefill_tok_s:.1f} tok/s | "
+          f"{decode_steps} decode steps in {dt:.3f}s | peak slot "
+          f"utilization {util_peak:.3f} | compilations "
+          f"{stats['prefill_compilations']}+{stats['decode_compilations']}",
+          file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_decode_steps_per_sec" if on_tpu
+                   else "serving_tiny_smoke_decode_steps_per_sec"),
+        "value": round(decode_steps / max(dt, 1e-9), 3),
+        "unit": "steps/sec",
+        # no reference arm for serving yet — recorded against itself
+        "vs_baseline": 1.0,
+        "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "cache_slot_utilization_peak": round(util_peak, 3),
+        "jit_programs": int(stats["prefill_compilations"]
+                            + stats["decode_compilations"]),
+    }
+
+
+def main():
+    on_tpu = _backend_with_cpu_fallback() == "tpu"
     # Headline: the BASELINE seq-512-class pretraining shape. With the
     # logsumexp MLM loss, B=16 WITHOUT per-layer remat fits the 16 GB
     # chip and beats every remat'd batch (no recompute tax). Round-4
@@ -798,9 +905,11 @@ def main():
         "vs_baseline": round(dt_base / dt_opt, 3),
     }
     print(json.dumps(result))
-    # BASELINE configs[1]-[3] + the long-context attention record
-    # (S=4096 on TPU by default; add S=2048 with --long-context)
-    secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling]
+    # BASELINE configs[1]-[3] + the serving section (round 6) + the
+    # long-context attention record (S=4096 on TPU by default; add
+    # S=2048 with --long-context)
+    secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
+                 bench_serving]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

@@ -171,6 +171,34 @@ def test_collective_stats_total_and_bytes():
     assert s["total"]["ops"] == 5
 
 
+def test_collective_stats_complex_f8_and_unknown_dtypes():
+    """Advisor r5 #2: c64/c128 and f8 payloads must be counted at their
+    true element sizes, and an unrecognized dtype must WARN instead of
+    silently assuming 4 bytes."""
+    import warnings
+
+    from apex_tpu.utils.hlo_audit import collective_stats
+
+    text = (
+        "%ar = c64[8,4]{1,0} all-reduce(%a), replica_groups={}\n"
+        "%ag = c128[2]{0} all-gather(%b)\n"
+        "%rs = f8e4m3fn[16]{0} reduce-scatter(%c)\n"
+        "%cp = f8e5m2[32]{0} collective-permute(%d)\n"
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # exact sizes: no warning fires
+        s = collective_stats(text)
+    assert s["all-reduce"]["bytes"] == 8 * 4 * 8
+    assert s["all-gather"]["bytes"] == 2 * 16
+    assert s["reduce-scatter"]["bytes"] == 16
+    assert s["collective-permute"]["bytes"] == 32
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        collective_stats("%x = zz9[4]{0} all-reduce(%a)\n")
+    assert any("unknown HLO dtype" in str(x.message) for x in w)
+
+
 def test_collective_audit_catches_migrated_grad_sync():
     """The deliberate regression for the ddp metric's companion field:
     replace the all-reduce grad sync with reduce-scatter + all-gather

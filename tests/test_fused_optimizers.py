@@ -450,6 +450,35 @@ def test_stochastic_round_is_unbiased_and_exact_on_representable():
     assert len(np.unique(np.asarray(r))) == 2
 
 
+def test_stochastic_round_never_overflows_finite_values_to_inf():
+    """Regression (advisor r5 #1): the mantissa-dither add can carry into
+    the exponent, so finite fp32 values in the last bf16 ULP below
+    bf16-max — or between bf16-max and fp32-max — must saturate at the
+    finite bf16 max, never round to inf (an inf in exp_avg_sq is sticky
+    and permanently kills that parameter's updates)."""
+    from apex_tpu.ops.multi_tensor import stochastic_round
+
+    bf16_max = float(jnp.finfo(jnp.bfloat16).max)
+    fp32_max = float(np.finfo(np.float32).max)
+    last_ulp = float(np.nextafter(np.float32(bf16_max), np.float32(0)))
+    boundary = jnp.asarray(
+        [bf16_max, -bf16_max, last_ulp, -last_ulp,
+         3.4e38, -3.4e38, fp32_max, -fp32_max],   # 3.4e38: finite fp32
+        jnp.float32)                              # strictly above bf16-max
+    # many keys: the overflow only fires for dither bits that carry
+    for seed in range(32):
+        out = np.asarray(
+            stochastic_round(boundary, jnp.bfloat16,
+                             jax.random.PRNGKey(seed)), np.float32)
+        assert np.isfinite(out).all(), (seed, out)
+        assert (np.abs(out) <= bf16_max).all(), (seed, out)
+    # true non-finite inputs still pass through untouched
+    inf = jnp.asarray([np.inf, -np.inf], jnp.float32)
+    out = np.asarray(stochastic_round(inf, jnp.bfloat16,
+                                      jax.random.PRNGKey(0)), np.float32)
+    assert np.isinf(out).all()
+
+
 def test_adam_bf16_moments_tracks_fp32_adam():
     """FusedAdam's bf16-moments tier: one step from zero moments must
     match the fp32 path to rounding tolerance, and the stored moments

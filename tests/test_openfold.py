@@ -47,6 +47,27 @@ def test_layer_norm_small_shape_impl_apply():
     np.testing.assert_allclose(np.asarray(y), np.asarray(_ln_ref(x, w, b)),
                                atol=2e-5)
 
+    # multi-dim normalized_shape: normalize over the flattened trailing
+    # dims (the Triton entry's semantics)
+    x2 = jnp.asarray(np.random.RandomState(5).randn(4, 6, 8).astype("f4"))
+    w2 = jnp.ones((6, 8), jnp.float32)
+    b2 = jnp.zeros((6, 8), jnp.float32)
+    y2 = LayerNormSmallShapeOptImpl.apply(x2, (6, 8), w2, b2)
+    want = _ln_ref(x2.reshape(4, 48), w2.reshape(48),
+                   b2.reshape(48)).reshape(4, 6, 8)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(want), atol=2e-5)
+
+
+def test_layer_norm_small_shape_impl_rejects_mismatched_shape():
+    """A normalized_shape that merely DIVIDES x.size must raise, not
+    silently normalize the wrong element grouping (advisor r5 #3):
+    here (8,) divides 4*6*64 but the trailing dim is 64."""
+    x = jnp.ones((4, 6, 64), jnp.float32)
+    w = jnp.ones((8,), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    with pytest.raises(ValueError, match="normalized_shape"):
+        LayerNormSmallShapeOptImpl.apply(x, (8,), w, b)
+
 
 def test_softmax_bias_mask_matches_composition():
     """softmax(scale*x + pair_bias) with a padding mask must equal the
@@ -103,14 +124,21 @@ def test_fused_adam_swa_matches_fused_adam_plus_average():
         np.asarray(s), np.asarray(p)), st.swa, params)
 
     p1, st1 = swa_opt.step(grads, st, params)
-    rp1, _ = ref_opt.step(grads, rst, params)
+    rp1, rst1 = ref_opt.step(grads, rst, params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-6), p1, rp1)
+    # first step: the average starts AT the first updated params (the
+    # AveragedModel first-capture contract) — no blend with the init
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), st1.swa, p1)
+
+    # second step onward: the EMA blend
+    p2, st2 = swa_opt.step(grads, st1, p1)
     want_swa = jax.tree.map(
         lambda s, p: d * s + (1 - d) * p.astype(jnp.float32),
-        st.swa, p1)
+        st1.swa, p2)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
-        np.asarray(a), np.asarray(b), rtol=1e-6), st1.swa, want_swa)
+        np.asarray(a), np.asarray(b), rtol=1e-6), st2.swa, want_swa)
 
     # swa_params casts to the model dtypes
     out = swa_opt.swa_params(st1, like=params)
@@ -132,14 +160,21 @@ def test_fused_adam_swa_skip_and_masters():
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), np.asarray(b)), st2.swa, st.swa)
 
-    # real step: swa tracks the fp32 MASTER trajectory, not the bf16 cast
+    # real step: swa tracks the fp32 MASTER trajectory, not the bf16
+    # cast — and the FIRST step copies the master (no blend)
     p3, st3 = opt.step(grads, st, params, skip_if=jnp.asarray(False))
     assert int(st3.step) == 1
-    want = jax.tree.map(
-        lambda s, m: 0.9 * s + 0.1 * m, st.swa, st3.master)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
-        np.asarray(a), np.asarray(b), rtol=1e-6), st3.swa, want)
+        np.asarray(a), np.asarray(b), rtol=1e-6), st3.swa, st3.master)
     assert p3["w"].dtype == jnp.bfloat16
+
+    # second real step: the EMA blend over the master trajectory
+    p4, st4 = opt.step(grads, st3, p3, skip_if=jnp.asarray(False))
+    assert int(st4.step) == 2
+    want = jax.tree.map(
+        lambda s, m: 0.9 * s + 0.1 * m, st3.swa, st4.master)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), st4.swa, want)
 
 
 def test_fused_adam_swa_under_jit():
