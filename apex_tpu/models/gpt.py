@@ -139,19 +139,26 @@ def _causal_attend(cfg, q, k, v, scale, dropout_rate=0.0, seed=None):
 
 
 def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
-                      cache_positions, seq_lens):
+                      cache_positions, seq_lens, write_start=None):
     """Serving attention against the paged KV-cache (flat (B, S, H)
     projections in, flat context out, plus the updated cache).
 
     Both serving modes write the freshly-projected K/V into the cache
     blocks first, then attend:
-    - prefill (S > 1): the context IS the prompt just computed, so the
-      causal flash/composed path runs on the contiguous K/V directly
-      (no cache read) with padding tokens key-masked;
+    - prefill chunk (S > 1): the chunk's queries attend against the
+      FULL cached context through the block table — the shared-prefix
+      blocks matched at admission, earlier chunks, and the chunk itself
+      — via :func:`apex_tpu.ops.flash_attention.paged_prefill_attention`
+      (causal by absolute position, padding key-masked by ``seq_lens``);
     - decode (S == 1): single-query attention against the block table
       via :func:`apex_tpu.ops.flash_attention.paged_decode_attention`.
-    The mode is static (S is a trace constant), so an engine compiles
-    exactly one program per shape — see docs/serving.md.
+    ``write_start`` (``[B]`` int32, optional) suppresses cache writes
+    below that absolute position: positions already in the cache — a
+    matched shared prefix, or a fully-cached prompt recomputing only
+    its last-position logits — must not be re-scattered (a shared block
+    belongs to other sequences too). The mode is static (S is a trace
+    constant), so an engine compiles exactly one program per shape —
+    see docs/serving.md.
     """
     from apex_tpu.serving.kv_cache import KVCache, paged_write
 
@@ -164,6 +171,8 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
     vh = v.reshape(B, S, nh, hd)
 
     valid = cache_positions < seq_lens[:, None]
+    if write_start is not None:
+        valid = valid & (cache_positions >= write_start[:, None])
     kv_cache = KVCache(
         k=paged_write(kv_cache.k, layer, block_tables, cache_positions,
                       kh, valid),
@@ -179,22 +188,12 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
                                      seq_lens, scale)
         return ctx.reshape(B, 1, h), kv_cache
 
-    key_mask = ~valid   # True = masked (the padding-mask convention)
+    from apex_tpu.ops.flash_attention import paged_prefill_attention
 
-    def heads(t):
-        return t.transpose(0, 2, 1, 3)
-
-    if cfg.fused_kernels:
-        from apex_tpu.ops.flash_attention import flash_attention
-
-        ctx = flash_attention(heads(qh), heads(kh), heads(vh), key_mask,
-                              True, scale)
-    else:
-        from apex_tpu.ops.flash_attention import mha_reference
-
-        ctx = mha_reference(heads(qh), heads(kh), heads(vh), key_mask,
-                            True, scale)
-    return ctx.transpose(0, 2, 1, 3).reshape(B, S, h), kv_cache
+    ctx = paged_prefill_attention(qh, kv_cache.k[layer],
+                                  kv_cache.v[layer], block_tables,
+                                  cache_positions, seq_lens, scale)
+    return ctx.reshape(B, S, h), kv_cache
 
 
 class GPTBlock(nn.Module):
@@ -204,7 +203,7 @@ class GPTBlock(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True, kv_cache=None,
                  layer: int = 0, block_tables=None, cache_positions=None,
-                 seq_lens=None):
+                 seq_lens=None, write_start=None):
         cfg = self.cfg
         h, nh = cfg.hidden_size, cfg.num_heads
         hd = h // nh
@@ -230,7 +229,7 @@ class GPTBlock(nn.Module):
         if kv_cache is not None:
             ctx, kv_cache = _cached_attention(
                 cfg, q, k, v, kv_cache, layer, block_tables,
-                cache_positions, seq_lens)
+                cache_positions, seq_lens, write_start)
             ctx = ctx.astype(cfg.dtype)
         elif cfg.attention_backend == "flash" and cfg.fused_kernels:
             from apex_tpu.ops.flash_attention import flash_attention_bsh
@@ -289,7 +288,7 @@ class GPTModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
                  position_offset=0, kv_cache=None, block_tables=None,
-                 cache_positions=None, seq_lens=None):
+                 cache_positions=None, seq_lens=None, write_start=None):
         cfg = self.cfg
         B, S_local = input_ids.shape
         wte = self.param("wte", _INIT, (cfg.vocab_size, cfg.hidden_size),
@@ -321,7 +320,7 @@ class GPTModel(nn.Module):
             for i in range(cfg.num_layers):
                 x, kv_cache = GPTBlock(cfg, False, name=f"h_{i}")(
                     x, deterministic, kv_cache, i, block_tables,
-                    cache_positions, seq_lens)
+                    cache_positions, seq_lens, write_start)
             return _norm(cfg, "ln_f")(x), wte, kv_cache
         if cfg.attention_backend in ("ring", "ulysses"):
             # sequence-sharded: this shard's global positions. Validate
@@ -376,12 +375,13 @@ class GPTLMHeadModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
                  position_offset=0, kv_cache=None, block_tables=None,
-                 cache_positions=None, seq_lens=None):
+                 cache_positions=None, seq_lens=None, write_start=None):
         if kv_cache is not None:
             x, wte, new_cache = GPTModel(self.cfg, name="transformer")(
                 input_ids, deterministic, position_offset,
                 kv_cache=kv_cache, block_tables=block_tables,
-                cache_positions=cache_positions, seq_lens=seq_lens)
+                cache_positions=cache_positions, seq_lens=seq_lens,
+                write_start=write_start)
             logits = jnp.einsum("bsh,vh->bsv", x, wte.astype(x.dtype),
                                 preferred_element_type=jnp.float32)
             return logits, new_cache

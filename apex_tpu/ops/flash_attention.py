@@ -1319,20 +1319,66 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
 
     Returns ``[B, H, D]`` in ``q.dtype``.
     """
-    B, H, D = q.shape
-    N, bs = k_pages.shape[0], k_pages.shape[1]
+    # decode IS the single-query case of the chunked-prefill kernel: a
+    # one-token "chunk" at position context_len - 1 (its causal mask
+    # kpos <= ctx-1 is exactly the decode mask kpos < ctx, including
+    # the empty-context lane, where both degrade to the uniform FILL
+    # read). One gather/mask/softmax chain to maintain, not two.
+    return paged_prefill_attention(
+        q[:, None], k_pages, v_pages, block_tables,
+        context_lens[:, None] - 1, context_lens, scale)[:, 0]
+
+
+def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
+                            context_lens, scale: float = 1.0):
+    """Chunked-prefill attention: a fixed-size chunk of queries against
+    the paged KV pool.
+
+    The serving engine prefills a prompt in fixed ``[1, chunk]`` pieces
+    (docs/serving.md): each chunk's K/V are scattered into the pool
+    first, then its queries attend over EVERYTHING the sequence has
+    cached so far — the shared-prefix blocks matched at admission, the
+    earlier chunks, and the chunk itself — under a causal-by-absolute-
+    position mask. Like :func:`paged_decode_attention` there is no
+    backward pass and the work is gather-dominated, so this is the same
+    fp32 masked-softmax chain, just with a query axis: scores are
+    ``[B, H, C, ctx_max]`` where ``C`` is the (small, fixed) chunk and
+    ``ctx_max`` the table's span. Dead key positions take the finite
+    FILL; a query past its sequence's length (chunk padding) still sees
+    at least key position 0, so padding lanes stay finite and are
+    simply ignored by the caller.
+
+    Args:
+      q: ``[B, C, H, D]`` — the chunk's query tokens.
+      k_pages, v_pages: ``[num_blocks, block_size, H, D]`` — ONE layer's
+        block pool (callers index the stacked ``[L, ...]`` cache); must
+        already contain this chunk's K/V.
+      block_tables: ``[B, max_blocks_per_seq]`` int32 block ids in
+        sequence order (out-of-bounds ids are clipped into the pool and
+        the positions masked by ``context_lens``).
+      q_positions: ``[B, C]`` int32 absolute position of each query
+        token (the chunk's offset into the sequence).
+      context_lens: ``[B]`` int32 — valid tokens in the cache INCLUDING
+        this chunk's.
+      scale: softmax temperature (typically ``1/sqrt(D)``).
+
+    Returns ``[B, C, H, D]`` in ``q.dtype``.
+    """
+    B, C, H, D = q.shape
+    N = k_pages.shape[0]
     tbl = jnp.minimum(block_tables, N - 1)
     k = k_pages[tbl].reshape(B, -1, H, D)        # [B, ctx_max, H, D]
     v = v_pages[tbl].reshape(B, -1, H, D)
     ctx_max = k.shape[1]
 
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
-    pos = jax.lax.broadcasted_iota(jnp.int32, (B, ctx_max), 1)
-    dead = pos >= context_lens[:, None]          # [B, ctx_max]
-    s = jnp.where(dead[:, None, :], FILL, s)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (B, ctx_max), 1)
+    visible = ((kpos[:, None, :] <= q_positions[:, :, None])
+               & (kpos[:, None, :] < context_lens[:, None, None]))
+    s = jnp.where(visible[:, None], s, FILL)     # [B, H, C, ctx_max]
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32),
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

@@ -20,11 +20,13 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     CacheOutOfBlocks,
     KVCache,
     blocks_needed,
+    copy_block,
     default_kv_dtype,
     defragment,
     device_block_table,
     gather_blocks,
     gather_kv,
+    hash_block_tokens,
     paged_write,
 )
 from apex_tpu.serving.sampling import (  # noqa: F401

@@ -1,37 +1,57 @@
-"""Continuous-batching inference engine: prefill/decode split over the
-paged KV-cache, with a fixed-shape scheduler.
+"""Continuous-batching inference engine: chunked prefill + decode over
+the paged KV-cache, with a fixed-shape scheduler, prefix caching, and
+optimistic admission backed by preemption.
 
 The Orca/vLLM serving loop (PAPERS.md) restated for XLA, where a shape
 change means a recompile and a recompile means a multi-second stall
-mid-traffic. The engine therefore holds a **two-program contract**:
+mid-traffic. The engine therefore holds a **fixed-program contract**:
 
 - ``prefill``: one request at a time at the fixed shape
-  ``[1, max_prefill_len]`` — prompt tokens right-padded, causal
-  attention with the padding key-masked, K/V written into freshly
-  allocated cache blocks, and the FIRST generated token sampled from
-  the last real position's logits.
-- ``decode``: ALL active slots at once at the fixed shape
-  ``[max_batch, 1]`` — each slot's last token attends against its block
-  table, one token sampled per slot. Inactive slots ride along as
-  masked lanes (their block-table rows point out of bounds, so their
-  writes drop and their outputs are ignored).
+  ``[1, prefill_chunk]``, iterated over the prompt — each chunk's K/V
+  are scattered into the sequence's cache blocks, then the chunk's
+  queries attend against EVERYTHING cached so far (matched prefix
+  blocks, earlier chunks, the chunk itself) through the block table
+  (Sarathi-style chunked prefill: a long prompt no longer head-of-line
+  blocks the decode slots, and prompts up to ``max_seq_len`` are
+  admissible regardless of the chunk size). The FIRST generated token
+  is sampled from the last real position's logits of the final chunk.
+- ``decode``: ALL slots at once at the fixed shape ``[max_batch, 1]``
+  — each started slot's last token attends against its block table,
+  one token sampled per slot. Non-decoding lanes (empty, or still
+  prefilling) ride along masked (their table rows point out of bounds,
+  so their writes drop and their outputs are ignored).
+- ``cow copy`` (rare): one block duplicated when a sequence would
+  append into a block it shares with another sequence — compiled
+  lazily, only if copy-on-write ever triggers.
 
 Everything that varies between steps — which slots are live, block
-tables, context lengths, sampling knobs — varies as *array values*, so
-XLA compiles exactly two programs for the lifetime of the engine
-(``stats()["prefill_compilations"] == 1`` and likewise for decode; the
-acceptance test pins this).
+tables, chunk offsets, context lengths, sampling knobs — varies as
+*array values*, so XLA compiles one program per shape for the lifetime
+of the engine (``stats()["prefill_compilations"] == 1`` and likewise
+for decode; the acceptance tests pin this).
 
-Scheduling (host-side, between jitted steps): admission fills free
-decode slots from the FIFO waiting queue whenever the request's
-WORST-CASE block count (prompt + full ``max_new_tokens`` budget) fits
-in the free pool net of what already-active slots may still claim
-(continuous batching — new requests join mid-flight, nothing waits for
-a "batch" to form); eviction frees a slot's blocks the moment it
-finishes (EOS sampled, or ``max_new_tokens`` reached). The worst-case
-reservation guarantees a decode-time block allocation can never fail;
-preemption/swapping (which would allow optimistic admission) is future
-work.
+Scheduling (host-side, between jitted steps), per ``step()``:
+
+1. **Admission** fills free decode slots from the FIFO waiting queue
+   on *current* need, not worst case: the prompt's uncached tail blocks
+   plus one must fit in the pool (free + evictable). With prefix
+   caching enabled, the longest block-aligned cached prefix is matched
+   by content hash and shared (refcounted) instead of recomputed.
+2. **One prefill chunk** runs for the oldest admitted request still
+   mid-prompt — at most one chunk per step ahead of the decode
+   dispatch, so decode slots keep streaming tokens while a long prompt
+   loads (stall-free batching).
+3. **Decode** advances every started slot one token. When a
+   decode-time block allocation fails, the YOUNGEST slot is preempted:
+   its references are released and the request re-queued at the front
+   carrying its already-generated tokens — on re-admission it re-
+   prefills ``prompt + generated[:-1]`` (cheap under prefix caching:
+   its own blocks are usually still cached) and continues, so emitted
+   tokens are never resampled and per-request output is deterministic.
+
+Finished requests *release references* instead of freeing: with prefix
+caching on, their full blocks stay indexed and evictable (LRU) until
+the pool actually needs the space.
 """
 
 from __future__ import annotations
@@ -49,7 +69,9 @@ from apex_tpu.serving.kv_cache import (
     CacheOutOfBlocks,
     KVCache,
     blocks_needed,
+    copy_block,
     device_block_table,
+    hash_block_tokens,
 )
 from apex_tpu.serving.sampling import SamplingParams, sample_tokens
 
@@ -72,8 +94,19 @@ class EngineConfig:
     max_batch: int = 8            # decode slots
     block_size: int = 16
     num_blocks: int = 256         # pool size (per layer)
-    max_prefill_len: int = 64     # THE prefill shape; prompts must fit
+    max_prefill_len: int = 64     # default prefill chunk (see below)
     max_seq_len: int = 256        # prompt + generation cap per sequence
+    # THE prefill shape: prompts are prefilled in [1, prefill_chunk]
+    # pieces, so prompts up to max_seq_len are admissible regardless of
+    # the chunk. None inherits max_prefill_len (the pre-chunking shape,
+    # keeping existing configs' compiled footprint identical).
+    prefill_chunk: Optional[int] = None
+    # Share identical block-aligned prompt prefixes through the
+    # allocator's content-hash index; finished requests' blocks stay
+    # cached (LRU-evictable) instead of freed. Off by default: caching
+    # retains pool blocks after a request finishes, which changes
+    # utilization accounting workloads may assert on.
+    enable_prefix_caching: bool = False
     kv_dtype: Optional[object] = None   # None = follow the amp policy
     # Donate the cache pool to the jitted steps so XLA updates it in
     # place instead of materializing a second pool + copy per step
@@ -86,14 +119,42 @@ class EngineConfig:
 
 
 @dataclasses.dataclass
-class _Slot:
-    """Host-side state of one active decode lane."""
+class _QueueEntry:
+    """A waiting (or preempted-and-requeued) request. ``generated``
+    carries tokens already emitted before a preemption so they are
+    never resampled — re-admission re-prefills ``prompt +
+    generated[:-1]`` and resumes decoding from ``generated[-1]``.
+    ``hashes`` memoizes the prefill sequence's block hash chain (the
+    sequence is frozen per entry), so a head blocked on pool pressure
+    is not re-hashed on every scheduler tick."""
 
     request: Request
-    context_len: int              # tokens currently in the cache
-    blocks: List[int]             # owned block ids, sequence order
+    generated: List[int] = dataclasses.field(default_factory=list)
+    hashes: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side state of one batch lane (prefilling or decoding)."""
+
+    entry: _QueueEntry
+    admit_seq: int                # monotonic admission order (preemption
+                                  # evicts the largest = youngest)
+    tokens: List[int]             # tokens whose K/V belong in the cache;
+                                  # grows by one per decode step
+    prefill_len: int              # tokens to cache before decoding starts
+    prefill_pos: int              # prompt tokens already cached
+    context_len: int              # tokens currently valid in the cache
+    blocks: List[int]             # owned/shared block ids, sequence order
+    block_hashes: List[str]       # chain hashes per full block (lazy tail)
+    num_registered: int           # full blocks already in the prefix index
     generated: List[int]
     last_token: int
+    started: bool                 # first token known -> decoding
+
+    @property
+    def request(self) -> Request:
+        return self.entry.request
 
 
 class InferenceEngine:
@@ -117,8 +178,12 @@ class InferenceEngine:
         self.model = model
         self.params = params
         self.config = config
-        if config.max_prefill_len > config.max_seq_len:
-            raise ValueError("max_prefill_len exceeds max_seq_len")
+        self._chunk = (config.prefill_chunk if config.prefill_chunk
+                       is not None else config.max_prefill_len)
+        if self._chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self._chunk > config.max_seq_len:
+            raise ValueError("prefill_chunk exceeds max_seq_len")
         if config.max_seq_len > cfg.max_position_embeddings:
             raise ValueError(
                 f"max_seq_len ({config.max_seq_len}) exceeds the model's "
@@ -135,27 +200,35 @@ class InferenceEngine:
         self.finished: Dict[str, List[int]] = {}
         self._key = jax.random.PRNGKey(config.seed)
         self._step_count = 0
+        self._admit_count = 0
         self._num_prefills = 0
+        self._num_prefill_chunks = 0
         self._num_decode_steps = 0
-        # the two programs; anything else jitted here would break the
-        # two-compilation contract the tests pin. Arg 1 is the cache
-        # pool in both signatures (donated when the runtime allows).
+        self._num_preemptions = 0
+        self._num_cow_copies = 0
+        self._prefix_hit_blocks = 0
+        self._prefix_lookup_blocks = 0
+        self._prompt_blocks_allocated = 0
+        # the fixed program set; anything else jitted here would break
+        # the compile-count contract the tests pin. Arg 1 is the cache
+        # pool in every signature (donated when the runtime allows).
         donate = (1,) if config.donate_cache else ()
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate)
         self._decode = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._cow = jax.jit(
+            copy_block, donate_argnums=(0,) if config.donate_cache else ())
 
-    # -- the two jitted programs ------------------------------------------
+    # -- the jitted programs ----------------------------------------------
 
-    def _prefill_impl(self, params, cache, ids, seq_len, table, key,
-                      temp, top_k, top_p):
-        P = ids.shape[1]
-        positions = jnp.arange(P, dtype=jnp.int32)[None]
+    def _prefill_impl(self, params, cache, ids, positions, seq_len,
+                      write_start, sample_idx, table, key, temp, top_k,
+                      top_p):
         logits, cache = self.model.apply(
             params, ids, deterministic=True, kv_cache=cache,
             block_tables=table, cache_positions=positions,
-            seq_lens=seq_len)
+            seq_lens=seq_len, write_start=write_start)
         last = jnp.take_along_axis(
-            logits, (seq_len - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
+            logits, sample_idx[:, None, None], axis=1)[:, 0]   # [1, V]
         tok = sample_tokens(last, key, temp, top_k, top_p)
         return cache, tok
 
@@ -180,28 +253,29 @@ class InferenceEngine:
                 f"request {request.uid!r}: max_new_tokens must be >= 1 "
                 f"(got {request.max_new_tokens}); prefill always samples "
                 "the first token")
-        if n > self.config.max_prefill_len:
-            raise ValueError(
-                f"request {request.uid!r}: prompt length {n} exceeds "
-                f"max_prefill_len ({self.config.max_prefill_len})")
         if n + request.max_new_tokens > self.config.max_seq_len:
             raise ValueError(
                 f"request {request.uid!r}: prompt + max_new_tokens "
                 f"({n} + {request.max_new_tokens}) exceeds max_seq_len "
                 f"({self.config.max_seq_len})")
         request.sampling.validate()
-        self.waiting.append(request)
+        self.waiting.append(_QueueEntry(request=request))
 
     def _next_key(self):
         self._step_count += 1
         return jax.random.fold_in(self._key, self._step_count)
 
-    def _host_tables(self) -> np.ndarray:
+    def _host_tables(self, decode_only: bool = False) -> np.ndarray:
+        """[max_batch, max_blocks_per_seq] host tables (-1 = unmapped).
+        ``decode_only`` leaves still-prefilling lanes unmapped so the
+        decode step's stray write at position 0 drops out of bounds
+        instead of corrupting their first block."""
         t = np.full((self.config.max_batch, self.max_blocks_per_seq), -1,
                     np.int32)
         for i, slot in enumerate(self.slots):
-            if slot is not None:
-                t[i, : len(slot.blocks)] = slot.blocks
+            if slot is None or (decode_only and not slot.started):
+                continue
+            t[i, : len(slot.blocks)] = slot.blocks
         return t
 
     def _sampling_arrays(self, per_slot):
@@ -215,8 +289,14 @@ class InferenceEngine:
         return (jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
 
     def _finish(self, idx: int) -> None:
+        """Release the slot: refs drop, and with prefix caching on the
+        registered blocks stay cached (evictable) rather than freed.
+        Released DEEPEST-first: eviction pops the oldest insertion, and
+        evicting a chain's head block orphans every descendant (the
+        lookup misses at hash 0), so the tail must age out before the
+        head for partial chains to stay matchable."""
         slot = self.slots[idx]
-        self.allocator.free(slot.blocks)
+        self.allocator.free(list(reversed(slot.blocks)))
         self.finished[slot.request.uid] = slot.generated
         self.slots[idx] = None
 
@@ -230,92 +310,254 @@ class InferenceEngine:
                 or len(slot.generated) >= req.max_new_tokens):
             self._finish(idx)
 
-    def _worst_case_blocks(self, req: Request) -> int:
-        return blocks_needed(len(req.prompt) + req.max_new_tokens,
-                             self.config.block_size)
+    # -- prefix caching ----------------------------------------------------
 
-    def _reserved_outstanding(self) -> int:
-        """Blocks the ACTIVE slots may still allocate before finishing
-        (their worst case minus what they already own). Admission
-        reserves against this so a decode-time ``alloc`` can never
-        fail — without preemption, over-commit would abort every
-        in-flight generation mid-step."""
-        total = 0
-        for s in self.slots:
-            if s is not None:
-                total += max(0, self._worst_case_blocks(s.request)
-                             - len(s.blocks))
-        return total
+    def _seq_hashes(self, tokens: Sequence[int]) -> List[str]:
+        bs = self.config.block_size
+        hashes, prev = [], None
+        for j in range(len(tokens) // bs):
+            prev = hash_block_tokens(prev, tokens[j * bs: (j + 1) * bs])
+            hashes.append(prev)
+        return hashes
+
+    def _register_full_blocks(self, slot: _Slot) -> None:
+        """Index every newly-FULL block of the slot (prompt blocks as
+        chunks land, generated blocks as decode crosses boundaries)."""
+        if not self.config.enable_prefix_caching:
+            return
+        bs = self.config.block_size
+        n_full = slot.context_len // bs
+        while slot.num_registered < n_full:
+            j = slot.num_registered
+            if j >= len(slot.block_hashes):
+                prev = slot.block_hashes[j - 1] if j else None
+                slot.block_hashes.append(hash_block_tokens(
+                    prev, slot.tokens[j * bs: (j + 1) * bs]))
+            self.allocator.register_prefix(slot.block_hashes[j],
+                                           slot.blocks[j])
+            slot.num_registered += 1
+
+    # -- admission (optimistic: current need, not worst case) --------------
 
     def _admit(self) -> int:
-        """Move waiting requests into free slots while capacity lasts:
-        the request's WORST-CASE block count (prompt + full generation
-        budget) must fit in the unreserved free pool. Returns the
-        number of requests admitted (a prefilled request may FINISH
-        during admission — max_new_tokens=1, or EOS on the first
-        sampled token — so progress cannot be read off the slots)."""
+        """Move waiting requests into free lanes while the pool can
+        cover their CURRENT need — the uncached prompt-tail blocks plus
+        one (vs. the old worst-case reservation of the full generation
+        budget, which collapsed pool utilization under long
+        ``max_new_tokens``; over-commit is safe now that decode-time
+        exhaustion preempts instead of aborting). Prefix caching makes
+        the need smaller still: the longest cached block-aligned prefix
+        is shared by reference, and only the tail is prefilled."""
+        bs = self.config.block_size
         admitted = 0
         for idx in range(self.config.max_batch):
             if not self.waiting or self.slots[idx] is not None:
                 continue
-            req = self.waiting[0]
-            free_unreserved = (self.allocator.num_free
-                               - self._reserved_outstanding())
-            if self._worst_case_blocks(req) > free_unreserved:
+            entry = self.waiting[0]
+            seq = list(entry.request.prompt)
+            if entry.generated:
+                seq += entry.generated[:-1]   # resume: re-cache history
+            L = len(seq)
+            matched: List[int] = []
+            hashes: List[str] = []
+            if self.config.enable_prefix_caching:
+                if entry.hashes is None:
+                    entry.hashes = self._seq_hashes(seq)
+                hashes = entry.hashes
+                matched = self.allocator.lookup_prefix(hashes)
+            tail = blocks_needed(L, bs) - len(matched)
+            # current need = blocks through the FIRST decode write
+            # (position L): blocks_needed(L + 1). That is tail + 1 only
+            # when the prompt exactly fills its blocks — an exact-fit
+            # request whose whole generation lives in the last partial
+            # block needs no headroom at all
+            need = blocks_needed(L + 1, bs) - len(matched)
+            # matched blocks that are currently cached (refcount 0)
+            # stop being evictable once we take them, so they don't
+            # count toward the capacity the tail can draw from
+            reviving = sum(1 for b in matched
+                           if self.allocator.refcount(b) == 0)
+            if (need > self.allocator.num_free
+                    + self.allocator.num_cached - reviving):
                 break   # FIFO: don't let a small request starve the head
-            need = blocks_needed(len(req.prompt), self.config.block_size)
+            self.allocator.acquire(matched)
             self.waiting.popleft()
-            blocks = self.allocator.alloc(need)
-            n = len(req.prompt)
-            P = self.config.max_prefill_len
-            ids = np.zeros((1, P), np.int32)
-            ids[0, :n] = np.asarray(req.prompt, np.int32)
-            table = np.full((1, self.max_blocks_per_seq), -1, np.int32)
-            table[0, : len(blocks)] = blocks
-            temp, top_k, top_p = self._sampling_arrays([req.sampling])
-            self.cache, tok = self._prefill(
-                self.params, self.cache, jnp.asarray(ids),
-                jnp.asarray([n], jnp.int32),
-                device_block_table(table, self.config.num_blocks),
-                self._next_key(), temp, top_k, top_p)
-            self._num_prefills += 1
-            self.slots[idx] = _Slot(request=req, context_len=n,
-                                    blocks=blocks, generated=[],
-                                    last_token=0)
-            self._record_token(idx, int(tok[0]))
+            blocks = matched + (self.allocator.alloc(tail) if tail else [])
+            m_tok = len(matched) * bs
+            self._prefix_lookup_blocks += len(hashes)
+            self._prefix_hit_blocks += len(matched)
+            self._prompt_blocks_allocated += tail
+            self._admit_count += 1
+            slot = _Slot(entry=entry, admit_seq=self._admit_count,
+                         tokens=seq, prefill_len=L, prefill_pos=m_tok,
+                         context_len=m_tok, blocks=blocks,
+                         block_hashes=list(hashes),
+                         num_registered=len(matched), generated=[],
+                         last_token=0, started=False)
+            if entry.generated and m_tok == L:
+                # resumed and fully cached: nothing to recompute at all
+                slot.generated = list(entry.generated)
+                slot.last_token = slot.generated[-1]
+                slot.started = True
+            self.slots[idx] = slot
             admitted += 1
         return admitted
 
+    # -- chunked prefill ---------------------------------------------------
+
+    def _prefill_tick(self) -> bool:
+        """Run ONE ``[1, prefill_chunk]`` piece for the oldest admitted
+        request still mid-prompt — at most one chunk per step, ahead of
+        the decode dispatch, so long prompts load without stalling the
+        streaming slots. A fully-prefix-cached prompt still runs one
+        final pass with writes suppressed (``write_start == L``): the
+        last position's logits are recomputed from the shared blocks
+        without allocating or touching a single one."""
+        cand = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                if s is not None and not s.started]
+        if not cand:
+            return False
+        idx = min(cand)[1]
+        slot = self.slots[idx]
+        L, C = slot.prefill_len, self._chunk
+        if slot.prefill_pos < L:
+            start = slot.prefill_pos
+        else:                       # fully cached: logits-only pass
+            start = max(0, L - C)
+        end = min(start + C, L)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, : end - start] = slot.tokens[start:end]
+        positions = (start + np.arange(C, dtype=np.int32))[None]
+        table = np.full((1, self.max_blocks_per_seq), -1, np.int32)
+        table[0, : len(slot.blocks)] = slot.blocks
+        temp, top_k, top_p = self._sampling_arrays([slot.request.sampling])
+        self.cache, tok = self._prefill(
+            self.params, self.cache, jnp.asarray(ids),
+            jnp.asarray(positions),
+            jnp.asarray([end], jnp.int32),
+            jnp.asarray([slot.prefill_pos], jnp.int32),     # write_start
+            jnp.asarray([(L - 1) - start], jnp.int32),      # sample_idx
+            device_block_table(table, self.config.num_blocks),
+            self._next_key(), temp, top_k, top_p)
+        self._num_prefill_chunks += 1
+        slot.prefill_pos = end
+        slot.context_len = max(slot.context_len, end)
+        self._register_full_blocks(slot)
+        if end == L:
+            self._num_prefills += 1
+            slot.started = True
+            if slot.entry.generated:
+                # resumed after preemption: the history's tokens are
+                # already emitted — never resample them
+                slot.generated = list(slot.entry.generated)
+                slot.last_token = slot.generated[-1]
+            else:
+                self._record_token(idx, int(tok[0]))
+        return True
+
+    # -- decode-time block growth, CoW, preemption -------------------------
+
+    def _preempt_for(self, requester: int) -> bool:
+        """Free the YOUNGEST lane to un-wedge an allocation for
+        ``requester``; its request re-queues at the front carrying its
+        generated tokens. Preempting youngest-first guarantees the
+        oldest request always progresses, so the system drains. Returns
+        False when the requester is the only lane (nothing to free —
+        the pool is simply too small for it)."""
+        cand = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                if s is not None]
+        if len(cand) <= 1:
+            return False
+        idx = max(cand)[1]
+        slot = self.slots[idx]
+        gen = (list(slot.generated) if slot.started
+               else list(slot.entry.generated))
+        # deepest-first, same as _finish: keep evictable chains matchable
+        self.allocator.free(list(reversed(slot.blocks)))
+        self.waiting.appendleft(_QueueEntry(request=slot.request,
+                                            generated=gen))
+        self.slots[idx] = None
+        self._num_preemptions += 1
+        return True
+
     def _ensure_decode_blocks(self) -> None:
-        """Each active slot is about to write K/V at position
-        ``context_len`` — allocate that block if the table doesn't
-        cover it yet."""
-        for slot in self.slots:
-            if slot is None:
-                continue
-            need = blocks_needed(slot.context_len + 1,
-                                 self.config.block_size)
-            while len(slot.blocks) < need:
-                slot.blocks.extend(self.allocator.alloc(1))
+        """Each started slot is about to write K/V at position
+        ``context_len`` — make sure a PRIVATE block covers it: allocate
+        at block boundaries (preempting the youngest lane if the pool
+        is dry), and copy-on-write when the covering block is shared
+        with another sequence (a full-block prefix match never shares a
+        partial tail, so CoW is a guard for exotic sharing patterns,
+        not the steady state)."""
+        bs = self.config.block_size
+        order = sorted((s.admit_seq, i) for i, s in enumerate(self.slots)
+                       if s is not None and s.started)
+        for _, i in order:
+            while self.slots[i] is not None:
+                slot = self.slots[i]
+                need = blocks_needed(slot.context_len + 1, bs)
+                if len(slot.blocks) < need:
+                    try:
+                        slot.blocks.extend(self.allocator.alloc(1))
+                    except CacheOutOfBlocks:
+                        if not self._preempt_for(i):
+                            raise CacheOutOfBlocks(
+                                f"request {slot.request.uid!r} cannot grow "
+                                f"past {slot.context_len} cached tokens: "
+                                f"0 blocks available of "
+                                f"{self.allocator.num_blocks} and no other "
+                                "lane left to preempt")
+                    continue   # re-check: the slot itself may be gone
+                b = slot.blocks[slot.context_len // bs]
+                if self.allocator.refcount(b) > 1:
+                    try:
+                        nb = self.allocator.alloc(1)[0]
+                    except CacheOutOfBlocks:
+                        if not self._preempt_for(i):
+                            raise CacheOutOfBlocks(
+                                f"request {slot.request.uid!r}: cannot "
+                                "copy-on-write a shared block, pool "
+                                "exhausted and no lane left to preempt")
+                        continue
+                    self.cache = self._cow(self.cache,
+                                           jnp.int32(b), jnp.int32(nb))
+                    self.allocator.free([b])
+                    slot.blocks[slot.context_len // bs] = nb
+                    # the copy diverges from the indexed contents the
+                    # moment we append; registration state stays with
+                    # the ORIGINAL block
+                    if slot.num_registered > slot.context_len // bs:
+                        slot.num_registered = slot.context_len // bs
+                    self._num_cow_copies += 1
+                break
 
     def step(self) -> None:
-        """One scheduler tick: admit, then one decode step for every
-        active slot (if any)."""
+        """One scheduler tick: admit, run at most one prefill chunk,
+        then one decode step for every started slot (if any)."""
         admitted = self._admit()
-        active = [i for i, s in enumerate(self.slots) if s is not None]
-        if not active:
-            if self.waiting and not admitted:
+        chunked = self._prefill_tick()
+        if all(s is None for s in self.slots):
+            if self.waiting and not admitted and not chunked:
                 # zero live sequences means nothing will ever free a
                 # block — the queue head can never be admitted (the
                 # pool is undersized for it). Raise, don't spin.
-                req = self.waiting[0]
+                entry = self.waiting[0]
+                need = blocks_needed(len(entry.request.prompt) + 1,
+                                     self.config.block_size)
                 raise CacheOutOfBlocks(
-                    f"request {req.uid!r} needs "
-                    f"{self._worst_case_blocks(req)} blocks worst-case "
-                    f"but only {self.allocator.num_free} of "
-                    f"{self.allocator.num_blocks} can ever be free")
+                    f"request {entry.request.uid!r} needs {need} blocks "
+                    f"to admit but only {self.allocator.num_blocks} exist "
+                    "in the pool")
+            return
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and s.started]
+        if not active:
             return
         self._ensure_decode_blocks()
+        # preemption may have cleared lanes — re-collect
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and s.started]
+        if not active:
+            return
         B = self.config.max_batch
         tokens = np.zeros((B, 1), np.int32)
         ctx = np.zeros((B,), np.int32)
@@ -323,17 +565,20 @@ class InferenceEngine:
             tokens[i, 0] = self.slots[i].last_token
             ctx[i] = self.slots[i].context_len
         temp, top_k, top_p = self._sampling_arrays(
-            [s.request.sampling if s is not None else None
+            [s.request.sampling if s is not None and s.started else None
              for s in self.slots])
         self.cache, toks = self._decode(
             self.params, self.cache, jnp.asarray(tokens),
-            device_block_table(self._host_tables(),
+            device_block_table(self._host_tables(decode_only=True),
                                self.config.num_blocks),
             jnp.asarray(ctx), self._next_key(), temp, top_k, top_p)
         self._num_decode_steps += 1
         toks = np.asarray(toks)
         for i in active:
-            self.slots[i].context_len += 1
+            slot = self.slots[i]
+            slot.tokens.append(slot.last_token)   # its K/V just landed
+            slot.context_len += 1
+            self._register_full_blocks(slot)
             self._record_token(i, int(toks[i]))
 
     def run(self) -> Dict[str, List[int]]:
@@ -345,12 +590,26 @@ class InferenceEngine:
         return out
 
     def stats(self) -> Dict[str, float]:
+        alloc = self.allocator
+        lookups = self._prefix_lookup_blocks
         return {
             "prefill_compilations": self._prefill._cache_size(),
             "decode_compilations": self._decode._cache_size(),
             "num_prefills": self._num_prefills,
+            "num_prefill_chunks": self._num_prefill_chunks,
             "num_decode_steps": self._num_decode_steps,
+            "num_preemptions": self._num_preemptions,
+            "num_cow_copies": self._num_cow_copies,
+            "num_cache_evictions": alloc.num_evictions,
             "active_slots": sum(s is not None for s in self.slots),
             "waiting": len(self.waiting),
-            "cache_utilization": self.allocator.utilization,
+            "cache_utilization": alloc.utilization,
+            "blocks_free": alloc.num_free,
+            "blocks_cached": alloc.num_cached,
+            "blocks_active": alloc.num_used,
+            "prefix_lookup_blocks": lookups,
+            "prefix_hit_blocks": self._prefix_hit_blocks,
+            "prefix_cache_hit_rate": (self._prefix_hit_blocks / lookups
+                                      if lookups else 0.0),
+            "prompt_blocks_allocated": self._prompt_blocks_allocated,
         }

@@ -13,16 +13,28 @@ trigger recompilation: the continuous-batching engine swaps table
 Division of labor (the load-bearing design point):
 
 - **Device side** (jit-stable, pure): :func:`paged_write` scatters new
-  K/V into blocks, :func:`gather_kv` reads a sequence back out, and
+  K/V into blocks, :func:`gather_kv` reads a sequence back out,
+  :func:`copy_block` duplicates one block (the copy-on-write step), and
   :func:`gather_blocks` applies a defrag permutation. All take the
   pool + int32 indices; invalid slots are routed to an out-of-bounds
   block id and dropped by the scatter (``mode="drop"``), so inactive
   batch slots cost nothing and write nowhere.
-- **Host side** (Python, between steps): :class:`BlockAllocator` is a
-  free-list over block ids — allocation, free, utilization — and
-  :func:`defragment` compacts live blocks to the low indices (returns
-  the gather permutation + rewritten tables). The scheduler consults
-  the allocator; the device never sees it.
+- **Host side** (Python, between steps): :class:`BlockAllocator` owns
+  the block ids — a free list, a per-block **reference count** (blocks
+  are shared between sequences under prefix caching), and a
+  **prefix index** mapping a hash-chain of full-block token contents to
+  the block id that already holds those tokens. ``free`` releases a
+  reference; a registered block whose refcount hits zero is *retained*
+  in an LRU set and only actually evicted when the free list runs dry
+  (:meth:`BlockAllocator.alloc` evicts least-recently-used cached
+  blocks on demand). The scheduler consults the allocator; the device
+  never sees it.
+
+Prefix caching hashes full blocks only: ``hash_block_tokens`` chains
+each block's hash through its predecessor's, so a block id is matched
+only when the *entire* token prefix up to and including that block is
+identical — the RadixAttention sharing rule (PAPERS.md) collapsed onto
+a flat dict.
 
 Storage dtype rides the existing amp policy: :func:`default_kv_dtype`
 returns the active ``amp.initialize`` handle's compute dtype (bf16 for
@@ -33,7 +45,9 @@ precision.
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional, Sequence
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -96,16 +110,49 @@ class KVCache(NamedTuple):
 
 
 class CacheOutOfBlocks(RuntimeError):
-    """The free list cannot serve an allocation (admission should have
-    been throttled, or the pool is fragmented — see :func:`defragment`)."""
+    """The allocator cannot serve an allocation even after evicting
+    every refcount-0 cached block (admission should have been
+    throttled, or the pool is simply undersized for the request)."""
+
+
+def hash_block_tokens(prev_hash: Optional[str],
+                      tokens: Sequence[int]) -> str:
+    """Chain hash for one FULL block of token ids. ``prev_hash`` is the
+    previous block's chain hash (``None`` for the first block), so equal
+    hashes imply the whole prefix up to and including this block is
+    equal — the property prefix matching relies on. SHA-256, not
+    Python's builtin ``hash``: the index serves KV blocks on hash
+    equality ALONE, so a collision would silently attend one request
+    against another request's cache (wrong tokens + cross-request
+    prompt leakage) — a non-cryptographic, PYTHONHASHSEED-dependent
+    hash is not acceptable there (vLLM hit exactly this)."""
+    h = hashlib.sha256()
+    if prev_hash is not None:
+        h.update(prev_hash.encode("ascii"))
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.hexdigest()
 
 
 class BlockAllocator:
-    """Host-side free-list over the pool's block ids.
+    """Host-side block-id accounting: free list + reference counts +
+    the prefix-cache index.
 
-    Lives entirely outside jit: the scheduler calls ``alloc``/``free``
-    between steps and writes the resulting ids into host block tables,
-    which are shipped to the device as plain int32 inputs.
+    Lives entirely outside jit: the scheduler calls ``alloc`` / ``free``
+    / ``match_prefix`` between steps and writes the resulting ids into
+    host block tables, which are shipped to the device as plain int32
+    inputs.
+
+    Lifecycle of a block id:
+
+    - **free** — on the free list; ``alloc`` hands it out with
+      refcount 1.
+    - **active** — refcount >= 1. ``acquire`` adds a reference (prefix
+      sharing), ``free`` drops one; dropping below zero raises (the
+      double-free guard).
+    - **cached** — refcount 0 but registered in the prefix index: the
+      block's contents are retained and matchable. ``alloc`` evicts
+      cached blocks least-recently-used when the free list is empty;
+      ``match_prefix`` revives them.
     """
 
     def __init__(self, num_blocks: int):
@@ -113,37 +160,139 @@ class BlockAllocator:
         # pop() from the end serves ascending ids first — keeps early
         # allocations compact, which makes defrag cheap in the common case
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._ref: Dict[int, int] = {}            # block id -> refcount (>0)
+        self._hash_to_block: Dict[str, int] = {}  # prefix index
+        self._block_to_hash: Dict[int, str] = {}
+        # refcount-0 registered blocks, insertion order = LRU order
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        self.num_evictions = 0
+
+    # -- accounting --------------------------------------------------------
 
     @property
     def num_free(self) -> int:
         return len(self._free)
 
     @property
+    def num_cached(self) -> int:
+        """Refcount-0 blocks retained for prefix reuse (evictable)."""
+        return len(self._evictable)
+
+    @property
     def num_used(self) -> int:
-        return self.num_blocks - len(self._free)
+        """Blocks currently referenced by live sequences."""
+        return self.num_blocks - len(self._free) - len(self._evictable)
 
     @property
     def utilization(self) -> float:
         """Fraction of pool blocks currently owned by live sequences."""
         return self.num_used / max(self.num_blocks, 1)
 
+    def refcount(self, block_id: int) -> int:
+        return self._ref.get(int(block_id), 0)
+
+    # -- alloc / free / share ----------------------------------------------
+
+    def _evict_one(self) -> int:
+        """Drop the least-recently-used cached block (unregister it)."""
+        b, _ = self._evictable.popitem(last=False)
+        h = self._block_to_hash.pop(b)
+        del self._hash_to_block[h]
+        self.num_evictions += 1
+        return b
+
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        """Hand out ``n`` blocks at refcount 1, evicting LRU cached
+        blocks when the free list alone cannot serve the request."""
+        if n > len(self._free) + len(self._evictable):
             raise CacheOutOfBlocks(
-                f"requested {n} blocks, {len(self._free)} free of "
-                f"{self.num_blocks}")
-        return [self._free.pop() for _ in range(n)]
+                f"requested {n} blocks, {len(self._free)} free + "
+                f"{len(self._evictable)} evictable of {self.num_blocks}")
+        out = []
+        for _ in range(n):
+            b = self._free.pop() if self._free else self._evict_one()
+            self._ref[b] = 1
+            out.append(b)
+        return out
 
     def free(self, ids: Sequence[int]) -> None:
+        """Release one reference per id. A registered block whose count
+        hits zero is retained as cached (evictable); an unregistered one
+        returns to the free list. Raises ``ValueError`` on an unknown
+        block id or a double free (releasing a block that holds no
+        reference) instead of silently corrupting the free list."""
         for b in ids:
+            b = int(b)
             if not (0 <= b < self.num_blocks):
                 raise ValueError(f"block id {b} out of range")
-            if b in self._free:
+            if self._ref.get(b, 0) <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if b in self._block_to_hash:
+                    self._evictable[b] = None      # most-recently-used end
+                else:
+                    self._free.append(b)
+
+    def acquire(self, ids: Sequence[int]) -> None:
+        """Add one reference per id (prefix sharing). Revives cached
+        (refcount-0) blocks; raises for blocks that are neither active
+        nor cached — a free block holds no meaningful contents."""
+        for b in ids:
+            b = int(b)
+            if self._ref.get(b, 0) > 0:
+                self._ref[b] += 1
+            elif b in self._evictable:
+                del self._evictable[b]
+                self._ref[b] = 1
+            else:
+                raise ValueError(
+                    f"cannot acquire block {b}: neither active nor cached")
+
+    # -- the prefix index --------------------------------------------------
+
+    def register_prefix(self, block_hash: str, block_id: int) -> bool:
+        """Index a FULL block's contents under its chain hash. First
+        registration wins — a concurrent identical prefill keeps the
+        already-indexed block and leaves the duplicate unregistered (it
+        returns to the free list when released). Returns whether this
+        block is now the indexed one."""
+        block_id = int(block_id)
+        if block_hash in self._hash_to_block:
+            return self._hash_to_block[block_hash] == block_id
+        if block_id in self._block_to_hash:   # already indexed elsewhere
+            return False
+        self._hash_to_block[block_hash] = block_id
+        self._block_to_hash[block_id] = block_hash
+        return True
+
+    def lookup_prefix(self, hashes: Sequence[str]) -> List[int]:
+        """Longest indexed prefix of the hash chain, WITHOUT taking
+        references — for capacity checks before committing to an
+        admission (no rollback, no LRU perturbation)."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def match_prefix(self, hashes: Sequence[str]) -> List[int]:
+        """Longest indexed prefix of the hash chain: returns the block
+        ids (in sequence order) and acquires a reference on each —
+        callers own the returned blocks and must ``free`` them."""
+        out = self.lookup_prefix(hashes)
+        self.acquire(out)
+        return out
 
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref.clear()
+        self._hash_to_block.clear()
+        self._block_to_hash.clear()
+        self._evictable.clear()
 
 
 def blocks_needed(num_tokens: int, block_size: int) -> int:
@@ -173,7 +322,7 @@ def paged_write(pages: jax.Array, layer: int, block_tables: jax.Array,
       values: ``[B, S, H, D]`` the tokens' K or V heads.
       valid: ``[B, S]`` bool; False routes the write out of bounds,
         where ``mode="drop"`` discards it (padding tokens, inactive
-        decode slots).
+        decode slots, already-cached prefix positions).
     """
     N, bs = pages.shape[1], pages.shape[2]
     page = jnp.take_along_axis(block_tables, positions // bs, axis=1)
@@ -196,6 +345,21 @@ def gather_kv(pages: jax.Array, layer: int,
     return out.reshape(B, M * bs, H, D)
 
 
+def copy_block(cache: KVCache, src, dst) -> KVCache:
+    """Duplicate one block's contents across every layer (``new[dst] =
+    old[src]``) — the device half of copy-on-write: when a sequence
+    would append into a block shared with another sequence, the
+    scheduler allocates a private block, copies the shared contents
+    here, and rewrites its table entry. ``src``/``dst`` may be traced
+    int32 scalars so a single jitted program serves every copy."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return KVCache(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+
+
 def gather_blocks(cache: KVCache, perm: jax.Array) -> KVCache:
     """Apply a block permutation to the pool (``new[i] = old[perm[i]]``)
     — the device half of :func:`defragment`."""
@@ -210,12 +374,21 @@ def defragment(cache: KVCache, allocator: BlockAllocator,
     sequences, so frees leave the pool checkerboarded; compaction
     restores a contiguous free region (and, on hardware with block-
     granular paging tricks, locality). Returns ``(new_cache,
-    new_host_tables)`` and rewrites the allocator's free list. The
-    device shuffle is one gather over the pool — call it rarely, from
-    a maintenance point, never inside the per-step loop.
+    new_host_tables)`` and rewrites the allocator's free list,
+    refcounts, and prefix index in the compacted id space. Refcount-0
+    cached blocks are dropped (they appear in no table, so compaction
+    cannot preserve them) — an acceptable trade for a maintenance op.
+    The device shuffle is one gather over the pool — call it rarely,
+    from a maintenance point, never inside the per-step loop.
     """
     tables = np.array(host_tables, np.int32, copy=True)
     live = np.unique(tables[tables >= 0])
+    live_set = {int(x) for x in live}
+    missing = [b for b in allocator._ref if b not in live_set]
+    if missing:
+        raise ValueError(
+            f"defragment: blocks {sorted(missing)} hold references but "
+            "appear in no table — allocator and tables are inconsistent")
     mapping = {int(old): new for new, old in enumerate(live)}
     perm = np.arange(cache.num_blocks, dtype=np.int32)
     perm[: len(live)] = live
@@ -227,5 +400,15 @@ def defragment(cache: KVCache, allocator: BlockAllocator,
     for idx, old in np.ndenumerate(tables):
         if old >= 0:
             tables[idx] = mapping[int(old)]
+    # rebuild allocator state in the compacted id space: cached blocks
+    # are evicted, live blocks keep their refcounts and index entries
+    allocator.num_evictions += len(allocator._evictable)
+    allocator._evictable.clear()
+    allocator._ref = {mapping[b]: c for b, c in allocator._ref.items()}
+    allocator._hash_to_block = {
+        h: mapping[b] for h, b in allocator._hash_to_block.items()
+        if b in mapping}
+    allocator._block_to_hash = {
+        b: h for h, b in allocator._hash_to_block.items()}
     allocator._free = list(range(cache.num_blocks - 1, len(live) - 1, -1))
     return gather_blocks(cache, jnp.asarray(perm)), tables

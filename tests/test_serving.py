@@ -293,8 +293,12 @@ def test_engine_eos_evicts_early():
 
 def test_engine_admission_control_and_validation():
     engine = _build_engine()
-    with pytest.raises(ValueError, match="max_prefill_len"):
-        engine.add_request(Request(uid="long", prompt=list(range(17))))
+    # prompts longer than the prefill chunk are admissible now (chunked
+    # prefill) — only the total budget is capped
+    engine.add_request(Request(uid="long-ok", prompt=list(range(17)),
+                               max_new_tokens=2))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.add_request(Request(uid="huge", prompt=[1] * 60))
     with pytest.raises(ValueError, match="max_seq_len"):
         engine.add_request(Request(uid="deep", prompt=[1] * 8,
                                    max_new_tokens=100))
@@ -306,15 +310,18 @@ def test_engine_admission_control_and_validation():
     with pytest.raises(ValueError, match="top_p"):
         engine.add_request(Request(uid="bad", prompt=[1],
                                    sampling=SamplingParams(top_p=0.0)))
+    out = engine.run()
+    assert set(out) == {"long-ok"}
 
 
-def test_engine_admission_reserves_worst_case_blocks():
-    """Two long-budget requests whose worst cases together exceed the
-    pool must be serialized by admission (second queued until the first
-    finishes) — never admitted together and crashed mid-decode."""
+def test_engine_optimistic_admission_overcommits_and_preempts():
+    """Two long-budget requests whose WORST cases together exceed the
+    pool are now admitted together on current need (prompt blocks + 1);
+    the resulting decode-time exhaustion preempts the youngest lane and
+    both still finish with full-length, correct output."""
     cfg, model, params = _tiny_model()
-    # pool of 5 blocks; each request's worst case is 8+24=32 tokens ->
-    # 4 blocks, so only one fits at a time
+    # pool of 5 blocks; worst case is 8+24=32 tokens -> 4 blocks each,
+    # but current need at admission is just 1 prompt block (+1 headroom)
     engine = InferenceEngine(model, params, EngineConfig(
         max_batch=2, block_size=8, num_blocks=5, max_prefill_len=8,
         max_seq_len=32))
@@ -322,12 +329,282 @@ def test_engine_admission_reserves_worst_case_blocks():
         engine.add_request(Request(uid=uid, prompt=[1, 2, 3, 4, 5, 6, 7, 8],
                                    max_new_tokens=24))
     engine.step()
-    assert engine.stats()["active_slots"] == 1
-    assert engine.stats()["waiting"] == 1
+    # the old worst-case reservation would have left "b" queued
+    assert engine.stats()["active_slots"] == 2
+    assert engine.stats()["waiting"] == 0
     out = engine.run()
     assert sorted(out) == ["a", "b"]
     assert all(len(v) == 24 for v in out.values())
+    stats = engine.stats()
+    assert stats["num_preemptions"] >= 1
+    assert stats["prefill_compilations"] == 1
+    assert stats["decode_compilations"] == 1
     assert engine.allocator.num_used == 0
+
+
+def test_exact_fit_request_is_admitted_without_headroom():
+    """A request whose whole generation lives inside its prompt's last
+    partial block needs NO headroom block: a pool exactly the size of
+    blocks_needed(prompt) must serve it (the naive 'prompt blocks + 1'
+    admission rule would wrongly raise CacheOutOfBlocks here)."""
+    cfg, model, params = _tiny_model()
+    engine = InferenceEngine(model, params, EngineConfig(
+        max_batch=1, block_size=8, num_blocks=4, max_prefill_len=8,
+        max_seq_len=32))
+    # 25 + 7 = 32 tokens -> exactly 4 blocks, generation never leaves
+    # block 3 (positions 25..31)
+    engine.add_request(Request(uid="fit", prompt=[1] * 25,
+                               max_new_tokens=7))
+    out = engine.run()
+    assert len(out["fit"]) == 7
+    assert engine.allocator.num_used == 0
+
+
+def test_preemption_preserves_greedy_outputs():
+    """Preemption-under-pressure determinism: the same greedy workload
+    served from a pool tight enough to force preemption must emit
+    byte-identical tokens to a pool that never preempts (emitted tokens
+    are carried across preemption, and the cached re-prefill rebuilds
+    the exact same context)."""
+    cfg, model, params = _tiny_model()
+    rng = np.random.RandomState(11)
+    reqs = [Request(uid=f"r{i}", prompt=list(rng.randint(0, 128, 6 + i)),
+                    max_new_tokens=20) for i in range(3)]
+
+    def serve(num_blocks):
+        engine = InferenceEngine(model, params, EngineConfig(
+            max_batch=3, block_size=8, num_blocks=num_blocks,
+            max_prefill_len=8, max_seq_len=32))
+        for r in reqs:
+            engine.add_request(r)
+        return engine.run(), engine.stats()
+
+    roomy, roomy_stats = serve(num_blocks=16)
+    tight, tight_stats = serve(num_blocks=6)
+    assert roomy_stats["num_preemptions"] == 0
+    assert tight_stats["num_preemptions"] >= 1
+    assert tight == roomy
+    assert tight_stats["prefill_compilations"] == 1
+    assert tight_stats["decode_compilations"] == 1
+
+
+def test_block_allocator_refcounts_prefix_index_and_lru_eviction():
+    """The prefix-cache contract on the allocator: registered full
+    blocks are matchable by hash chain, sharing is refcounted, freed
+    registered blocks are retained (cached) until allocation pressure
+    evicts them least-recently-used."""
+    from apex_tpu.serving import hash_block_tokens
+
+    a = BlockAllocator(4)
+    h1 = hash_block_tokens(None, [7] * 8)
+    h2 = hash_block_tokens(h1, [9] * 8)
+    b = a.alloc(2)
+    assert a.register_prefix(h1, b[0]) and a.register_prefix(h2, b[1])
+    # a second holder matches the chain and shares by reference
+    assert a.match_prefix([h1, h2]) == b
+    assert a.refcount(b[0]) == 2 and a.refcount(b[1]) == 2
+    # a chain that diverges after the first block matches one block only
+    h2x = hash_block_tokens(h1, [1] * 8)
+    assert a.match_prefix([h1, h2x]) == [b[0]]
+    a.free([b[0]])
+    a.free(b)
+    a.free(b)   # all references released -> cached, NOT freed
+    assert a.num_free == 2 and a.num_cached == 2 and a.num_used == 0
+    # matching revives a cached block
+    got = a.match_prefix([h1])
+    assert got == [b[0]] and a.num_cached == 1 and a.refcount(b[0]) == 1
+    a.free(got)  # LRU order is now [b1, b0]: b0 was just revived
+    # allocation beyond the free list evicts least-recently-used first
+    c = a.alloc(3)
+    assert a.num_evictions == 1 and a.num_cached == 1
+    assert a.match_prefix([h2]) == []             # h2's block was evicted
+    got = a.match_prefix([h1, h2])                # h1's (recent) survived
+    assert got == [b[0]]
+    a.free(got)
+    a.free(c)
+    assert a.num_free + a.num_cached == 4 and a.num_used == 0
+
+
+def test_block_allocator_free_raises_on_double_free_and_unknown_id():
+    a = BlockAllocator(4)
+    b = a.alloc(1)
+    a.free(b)
+    with pytest.raises(ValueError, match="double free"):
+        a.free(b)
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([17])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([2])   # never allocated
+    # the failed frees must not have corrupted the free list
+    assert sorted(a.alloc(4)) == [0, 1, 2, 3]
+
+
+def _prefix_engine(model, params, **kw):
+    base = dict(max_batch=4, block_size=8, num_blocks=64,
+                max_prefill_len=16, max_seq_len=64)
+    base.update(kw)
+    return InferenceEngine(model, params, EngineConfig(**base))
+
+
+def test_chunked_prefill_admits_long_prompts_and_matches_monolithic():
+    """A prompt longer than the prefill chunk must be admissible and
+    emit byte-identical greedy tokens to a monolithic (one-chunk)
+    prefill of the same prompt — the chunk loop attends each chunk
+    against the previously-written cache blocks, so chunking is purely
+    an execution-schedule choice."""
+    cfg, model, params = _tiny_model()
+    prompt = list(np.random.RandomState(5).randint(0, 128, 40))
+
+    mono = _prefix_engine(model, params, max_prefill_len=48)
+    mono.add_request(Request(uid="m", prompt=prompt, max_new_tokens=6))
+    ref = mono.run()["m"]
+    assert mono.stats()["num_prefill_chunks"] == 1
+
+    chunked = _prefix_engine(model, params, max_prefill_len=48,
+                             prefill_chunk=16)
+    chunked.add_request(Request(uid="c", prompt=prompt, max_new_tokens=6))
+    out = chunked.run()["c"]
+    assert out == ref
+    stats = chunked.stats()
+    assert stats["num_prefill_chunks"] == 3   # ceil(40 / 16)
+    assert stats["prefill_compilations"] == 1
+    assert stats["decode_compilations"] == 1
+
+
+def test_prefix_cached_second_serving_allocates_zero_prompt_blocks():
+    """THE acceptance scenario: an identical (block-aligned) prompt
+    served twice with prefix caching emits identical tokens both times,
+    and the second admission matches every prompt block from the cache
+    — zero new prompt blocks, and the first-token logits are recomputed
+    from shared blocks without a single cache write."""
+    cfg, model, params = _tiny_model()
+    prompt = list(np.random.RandomState(9).randint(0, 128, 32))  # 4 blocks
+
+    plain = _prefix_engine(model, params)
+    plain.add_request(Request(uid="p", prompt=prompt, max_new_tokens=6))
+    ref = plain.run()["p"]
+
+    engine = _prefix_engine(model, params, enable_prefix_caching=True)
+    engine.add_request(Request(uid="one", prompt=prompt, max_new_tokens=6))
+    first = engine.run()["one"]
+    assert first == ref
+    s1 = engine.stats()
+    assert s1["blocks_cached"] > 0          # finished blocks retained
+    assert engine.allocator.num_used == 0
+
+    engine.add_request(Request(uid="two", prompt=prompt, max_new_tokens=6))
+    second = engine.run()["two"]
+    assert second == ref
+    s2 = engine.stats()
+    # every prompt block came from the cache: nothing newly allocated
+    assert s2["prefix_hit_blocks"] - s1["prefix_hit_blocks"] == 4
+    assert (s2["prompt_blocks_allocated"]
+            == s1["prompt_blocks_allocated"])
+    # one logits-only pass replaces the whole prefill
+    assert s2["num_prefill_chunks"] - s1["num_prefill_chunks"] == 1
+    # the fixed-program contract survives caching, chunking, both runs
+    assert s2["prefill_compilations"] == 1
+    assert s2["decode_compilations"] == 1
+    assert 0.0 < s2["prefix_cache_hit_rate"] <= 1.0
+
+
+def test_prefix_cache_shares_blocks_between_live_requests():
+    """Two concurrent requests with a shared block-aligned prefix:
+    the second must reference the first's prompt blocks (refcount 2)
+    rather than re-prefilling them, once the first has registered them."""
+    cfg, model, params = _tiny_model()
+    rng = np.random.RandomState(13)
+    shared = list(rng.randint(0, 128, 16))          # 2 full blocks
+    a = Request(uid="a", prompt=shared + [3], max_new_tokens=12)
+    b = Request(uid="b", prompt=shared + [5], max_new_tokens=12)
+
+    engine = _prefix_engine(model, params, enable_prefix_caching=True)
+    engine.add_request(a)
+    engine.step()                 # a prefilled; its full blocks registered
+    engine.add_request(b)
+    engine.step()                 # b admitted: matches the 2 shared blocks
+    slot_a = next(s for s in engine.slots if s and s.request.uid == "a")
+    slot_b = next(s for s in engine.slots if s and s.request.uid == "b")
+    assert slot_b.blocks[:2] == slot_a.blocks[:2]
+    assert all(engine.allocator.refcount(x) == 2
+               for x in slot_a.blocks[:2])
+    out = engine.run()
+    # sharing must not contaminate either generation: each must equal
+    # its solo (uncached) serving
+    for req in (a, b):
+        solo = _prefix_engine(model, params)
+        solo.add_request(req)
+        assert solo.run()[req.uid] == out[req.uid]
+    assert engine.allocator.num_used == 0
+
+
+def test_copy_on_write_unshares_a_shared_partial_tail():
+    """If a slot's partial tail block is shared (refcount > 1), the
+    decode append must copy it to a private block first — and the copy
+    must preserve contents exactly (greedy continuation unchanged)."""
+    cfg, model, params = _tiny_model()
+    prompt = list(np.random.RandomState(17).randint(0, 128, 12))
+
+    ref_engine = _prefix_engine(model, params, enable_prefix_caching=True)
+    ref_engine.add_request(Request(uid="r", prompt=prompt,
+                                   max_new_tokens=8))
+    ref = ref_engine.run()["r"]
+
+    engine = _prefix_engine(model, params, enable_prefix_caching=True)
+    engine.add_request(Request(uid="x", prompt=prompt, max_new_tokens=8))
+    engine.step()     # prefill (12 tokens -> blocks [full, partial])
+    slot = next(s for s in engine.slots if s is not None)
+    tail = slot.blocks[1]
+    engine.allocator.acquire([tail])      # simulate a second holder
+    out = engine.run()["x"]
+    assert engine.stats()["num_cow_copies"] >= 1
+    assert out == ref                     # copy preserved the contents
+    # the shared original still belongs to the simulated holder
+    assert engine.allocator.refcount(tail) == 1
+    engine.allocator.free([tail])
+    assert engine.allocator.num_used == 0
+
+
+def test_lru_eviction_keeps_engine_serving_under_cache_pressure():
+    """With prefix caching on, finished requests' blocks pile up as
+    cached; a stream of distinct prompts must keep serving by evicting
+    LRU cached blocks instead of running out of pool."""
+    cfg, model, params = _tiny_model()
+    engine = _prefix_engine(model, params, num_blocks=16,
+                            enable_prefix_caching=True)
+    rng = np.random.RandomState(23)
+    for i in range(8):
+        engine.add_request(Request(uid=f"s{i}",
+                                   prompt=list(rng.randint(0, 128, 16)),
+                                   max_new_tokens=8))
+    out = engine.run()
+    assert len(out) == 8 and all(len(v) == 8 for v in out.values())
+    stats = engine.stats()
+    assert stats["num_cache_evictions"] > 0
+    assert stats["prefill_compilations"] == 1
+    assert stats["decode_compilations"] == 1
+
+
+def test_stats_reports_block_accounting_and_scheduler_counters():
+    cfg, model, params = _tiny_model()
+    engine = _prefix_engine(model, params, enable_prefix_caching=True)
+    prompt = list(np.random.RandomState(29).randint(0, 128, 16))
+    engine.add_request(Request(uid="a", prompt=prompt, max_new_tokens=4))
+    engine.step()   # a prefills and registers its full blocks
+    engine.add_request(Request(uid="b", prompt=prompt, max_new_tokens=4))
+    engine.run()
+    stats = engine.stats()
+    for key in ("blocks_free", "blocks_cached", "blocks_active",
+                "prefix_cache_hit_rate", "prefix_hit_blocks",
+                "prefix_lookup_blocks", "num_preemptions",
+                "num_cow_copies", "num_cache_evictions",
+                "num_prefill_chunks", "prompt_blocks_allocated"):
+        assert key in stats, key
+    assert (stats["blocks_free"] + stats["blocks_cached"]
+            + stats["blocks_active"]) == engine.config.num_blocks
+    assert stats["blocks_active"] == 0          # everything finished
+    assert stats["prefix_hit_blocks"] >= 2      # b reused a's blocks
+    assert 0.0 <= stats["prefix_cache_hit_rate"] <= 1.0
 
 
 def test_engine_raises_when_pool_can_never_serve_the_queue():
