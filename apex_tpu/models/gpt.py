@@ -24,7 +24,11 @@ Serving: ``apply(..., kv_cache=...)`` (plus ``block_tables`` /
 ``cache_positions`` / ``seq_lens``) switches to the paged-KV-cache
 inference path — prefill writes the prompt's K/V into cache blocks and
 runs the ordinary causal attention; a one-token call decodes against
-the block table. See :mod:`apex_tpu.serving` and docs/serving.md.
+the block table. The engine's multi-step decode traces this one-token
+call once as the body of a ``jax.lax.scan`` (K fused iterations per
+dispatch), so everything here must be — and is — shape-stable under
+traced ``cache_positions``/``seq_lens`` that advance inside the loop.
+See :mod:`apex_tpu.serving` and docs/serving.md.
 """
 
 from __future__ import annotations
@@ -156,9 +160,12 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
     below that absolute position: positions already in the cache — a
     matched shared prefix, or a fully-cached prompt recomputing only
     its last-position logits — must not be re-scattered (a shared block
-    belongs to other sequences too). The mode is static (S is a trace
-    constant), so an engine compiles exactly one program per shape —
-    see docs/serving.md.
+    belongs to other sequences too). The multi-step decode scan also
+    leans on it to FREEZE a lane mid-scan (EOS / budget exhausted):
+    setting a lane's ``write_start`` one past its ``cache_positions``
+    drops its scatter while the lane's query harmlessly rides the
+    batch. The mode is static (S is a trace constant), so an engine
+    compiles exactly one program per shape — see docs/serving.md.
     """
     from apex_tpu.serving.kv_cache import KVCache, paged_write
 

@@ -1324,9 +1324,13 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
     # kpos <= ctx-1 is exactly the decode mask kpos < ctx, including
     # the empty-context lane, where both degrade to the uniform FILL
     # read). One gather/mask/softmax chain to maintain, not two.
+    # q_positions=None selects the collapsed single-comparison mask —
+    # this call sits inside the engine's K-step decode scan, so the
+    # per-query mask broadcast it skips would otherwise run K times
+    # per dispatch.
     return paged_prefill_attention(
         q[:, None], k_pages, v_pages, block_tables,
-        context_lens[:, None] - 1, context_lens, scale)[:, 0]
+        None, context_lens, scale)[:, 0]
 
 
 def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
@@ -1357,7 +1361,15 @@ def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
         sequence order (out-of-bounds ids are clipped into the pool and
         the positions masked by ``context_lens``).
       q_positions: ``[B, C]`` int32 absolute position of each query
-        token (the chunk's offset into the sequence).
+        token (the chunk's offset into the sequence) — or ``None``, the
+        decode fast path: every query is THE LAST cached position
+        (``context_lens - 1``), so the causal and length masks collapse
+        into the single comparison ``kpos < context_lens`` and the
+        per-query ``[B, C, ctx_max]`` mask broadcast is skipped
+        entirely (the mask VALUES are bit-identical; only the work to
+        build them goes away). The engine's multi-step decode scan runs
+        this mask once per inner iteration, which is what makes the
+        skip worth having.
       context_lens: ``[B]`` int32 — valid tokens in the cache INCLUDING
         this chunk's.
       scale: softmax temperature (typically ``1/sqrt(D)``).
@@ -1375,8 +1387,13 @@ def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
     kpos = jax.lax.broadcasted_iota(jnp.int32, (B, ctx_max), 1)
-    visible = ((kpos[:, None, :] <= q_positions[:, :, None])
-               & (kpos[:, None, :] < context_lens[:, None, None]))
+    if q_positions is None:
+        # decode: kpos <= ctx-1 AND kpos < ctx are the same predicate;
+        # [B, 1, ctx_max] broadcasts over both H and the C=1 query axis
+        visible = (kpos < context_lens[:, None])[:, None, :]
+    else:
+        visible = ((kpos[:, None, :] <= q_positions[:, :, None])
+                   & (kpos[:, None, :] < context_lens[:, None, None]))
     s = jnp.where(visible[:, None], s, FILL)     # [B, H, C, ctx_max]
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),

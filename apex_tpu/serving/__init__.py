@@ -18,6 +18,7 @@ from apex_tpu.serving.engine import (  # noqa: F401
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator,
     CacheOutOfBlocks,
+    DeviceMirror,
     KVCache,
     blocks_needed,
     copy_block,
@@ -32,4 +33,5 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
 from apex_tpu.serving.sampling import (  # noqa: F401
     SamplingParams,
     sample_tokens,
+    sample_tokens_per_lane,
 )

@@ -1,6 +1,6 @@
-"""Continuous-batching inference engine: chunked prefill + decode over
-the paged KV-cache, with a fixed-shape scheduler, prefix caching, and
-optimistic admission backed by preemption.
+"""Continuous-batching inference engine: chunked prefill + multi-step
+fused decode over the paged KV-cache, with a fixed-shape scheduler,
+prefix caching, and optimistic admission backed by preemption.
 
 The Orca/vLLM serving loop (PAPERS.md) restated for XLA, where a shape
 change means a recompile and a recompile means a multi-second stall
@@ -15,11 +15,25 @@ mid-traffic. The engine therefore holds a **fixed-program contract**:
   blocks the decode slots, and prompts up to ``max_seq_len`` are
   admissible regardless of the chunk size). The FIRST generated token
   is sampled from the last real position's logits of the final chunk.
-- ``decode``: ALL slots at once at the fixed shape ``[max_batch, 1]``
-  — each started slot's last token attends against its block table,
-  one token sampled per slot. Non-decoding lanes (empty, or still
-  prefilling) ride along masked (their table rows point out of bounds,
-  so their writes drop and their outputs are ignored).
+- ``decode``: ALL slots at once, ``decode_steps`` (K) iterations fused
+  into ONE dispatch via ``jax.lax.scan`` — each inner step writes the
+  previous token's K/V through the block table, attends, samples one
+  token per lane (per-lane PRNG keys, see below), advances per-lane
+  context lengths on-device, and feeds the token back as the next
+  query. A per-lane active mask freezes lanes that hit EOS or their
+  ``max_new_tokens`` budget mid-scan: frozen lanes stop writing
+  (``write_start`` pushes their scatter out of the valid range) and
+  emit a ``-1`` sentinel. The program returns ``[max_batch, K]`` tokens
+  (``-1`` sentinels past each lane's emitted prefix), and the host
+  fetch is DEFERRED: the next tick's admission and prefill work is
+  dispatched before the host blocks on the in-flight decode, so
+  scheduler overhead overlaps device compute. ``K == 1`` runs the same
+  single-token computation and scheduling cadence as the pre-multistep
+  engine (greedy outputs are unchanged; sampled draws come from the
+  rekeyed per-request scheme below, which intentionally replaced the
+  old step-counter keys at every K). Non-decoding lanes (empty, or
+  still prefilling) ride along masked (their table rows point out of
+  bounds, so their writes drop and their outputs are ignored).
 - ``cow copy`` (rare): one block duplicated when a sequence would
   append into a block it shares with another sequence — compiled
   lazily, only if copy-on-write ever triggers.
@@ -28,9 +42,21 @@ Everything that varies between steps — which slots are live, block
 tables, chunk offsets, context lengths, sampling knobs — varies as
 *array values*, so XLA compiles one program per shape for the lifetime
 of the engine (``stats()["prefill_compilations"] == 1`` and likewise
-for decode; the acceptance tests pin this).
+for decode; the acceptance tests pin this). The block table and the
+per-lane sampling/EOS/key arrays are **dirty-tracked device-resident
+mirrors** (:class:`~apex_tpu.serving.kv_cache.DeviceMirror`):
+re-uploaded when the slot composition or a table row changes, reused
+untouched on the steady-state tick.
 
-Scheduling (host-side, between jitted steps), per ``step()``:
+Sampling determinism is **schedule-invariant**: every request owns a
+PRNG key (the engine seed folded with the request's arrival index),
+and its ``j``-th generated token is drawn with
+``fold_in(request_key, j)`` — on-device, the scan folds the running
+per-lane generated-count into the lane's key each iteration. Outputs
+are therefore bit-for-bit identical for any ``decode_steps``, any lane
+placement, and any preemption/resume schedule (tested).
+
+Scheduling (host-side, between jitted dispatches), per ``step()``:
 
 1. **Admission** fills free decode slots from the FIFO waiting queue
    on *current* need, not worst case: the prompt's uncached tail blocks
@@ -41,13 +67,20 @@ Scheduling (host-side, between jitted steps), per ``step()``:
    mid-prompt — at most one chunk per step ahead of the decode
    dispatch, so decode slots keep streaming tokens while a long prompt
    loads (stall-free batching).
-3. **Decode** advances every started slot one token. When a
-   decode-time block allocation fails, the YOUNGEST slot is preempted:
-   its references are released and the request re-queued at the front
-   carrying its already-generated tokens — on re-admission it re-
-   prefills ``prompt + generated[:-1]`` (cheap under prefix caching:
-   its own blocks are usually still cached) and continues, so emitted
-   tokens are never resampled and per-request output is deterministic.
+3. **Drain** the PREVIOUS tick's decode dispatch (the deferred sync):
+   fetch its ``[B, K]`` tokens + counts, append K/V bookkeeping,
+   register newly-full blocks, finish/evict satisfied requests, then
+   top up admissions into any lanes that just freed.
+4. **Decode** dispatches the next fused K-step scan for every started
+   slot. When a K-step block reservation fails, the YOUNGEST slot is
+   preempted: its references are released and the request re-queued at
+   the front carrying its already-generated tokens — on re-admission
+   it re-prefills ``prompt + generated[:-1]`` (cheap under prefix
+   caching: its own blocks are usually still cached) and continues, so
+   emitted tokens are never resampled and per-request output is
+   deterministic. Preemption granularity is K tokens: a preempted lane
+   loses at most the current dispatch's unconsumed reservation, never
+   an emitted token.
 
 Finished requests *release references* instead of freeing: with prefix
 caching on, their full blocks stay indexed and evictable (LRU) until
@@ -67,13 +100,18 @@ import numpy as np
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     CacheOutOfBlocks,
+    DeviceMirror,
     KVCache,
     blocks_needed,
     copy_block,
     device_block_table,
     hash_block_tokens,
 )
-from apex_tpu.serving.sampling import SamplingParams, sample_tokens
+from apex_tpu.serving.sampling import (
+    SamplingParams,
+    sample_tokens,
+    sample_tokens_per_lane,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +139,15 @@ class EngineConfig:
     # the chunk. None inherits max_prefill_len (the pre-chunking shape,
     # keeping existing configs' compiled footprint identical).
     prefill_chunk: Optional[int] = None
+    # Multi-step fused decode: each decode dispatch runs this many
+    # scanned iterations on-device, amortizing one scheduler tick (host
+    # table/array work + dispatch + fetch) over K generated tokens.
+    # Outputs are bit-identical for any K (per-request, per-token PRNG
+    # keys); K trades per-token latency (tokens surface K at a time)
+    # for throughput, and makes K tokens the preemption granularity.
+    # 1 keeps the pre-multistep single-token cadence (sampled draws
+    # use the rekeyed per-request scheme at every K, including 1).
+    decode_steps: int = 1
     # Share identical block-aligned prompt prefixes through the
     # allocator's content-hash index; finished requests' blocks stay
     # cached (LRU-evictable) instead of freed. Off by default: caching
@@ -124,11 +171,15 @@ class _QueueEntry:
     carries tokens already emitted before a preemption so they are
     never resampled — re-admission re-prefills ``prompt +
     generated[:-1]`` and resumes decoding from ``generated[-1]``.
-    ``hashes`` memoizes the prefill sequence's block hash chain (the
-    sequence is frozen per entry), so a head blocked on pool pressure
-    is not re-hashed on every scheduler tick."""
+    ``arrival`` is the request's add_request order: it seeds the
+    request's PRNG key, so it must survive preemption unchanged (the
+    resumed request continues the SAME key sequence at the next token
+    index). ``hashes`` memoizes the prefill sequence's block hash chain
+    (the sequence is frozen per entry), so a head blocked on pool
+    pressure is not re-hashed on every scheduler tick."""
 
     request: Request
+    arrival: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     hashes: Optional[List[str]] = None
 
@@ -141,7 +192,7 @@ class _Slot:
     admit_seq: int                # monotonic admission order (preemption
                                   # evicts the largest = youngest)
     tokens: List[int]             # tokens whose K/V belong in the cache;
-                                  # grows by one per decode step
+                                  # grows by one per decoded token
     prefill_len: int              # tokens to cache before decoding starts
     prefill_pos: int              # prompt tokens already cached
     context_len: int              # tokens currently valid in the cache
@@ -184,6 +235,8 @@ class InferenceEngine:
             raise ValueError("prefill_chunk must be >= 1")
         if self._chunk > config.max_seq_len:
             raise ValueError("prefill_chunk exceeds max_seq_len")
+        if config.decode_steps < 1:
+            raise ValueError("decode_steps must be >= 1")
         if config.max_seq_len > cfg.max_position_embeddings:
             raise ValueError(
                 f"max_seq_len ({config.max_seq_len}) exceeds the model's "
@@ -199,16 +252,28 @@ class InferenceEngine:
         self.waiting: deque = deque()
         self.finished: Dict[str, List[int]] = {}
         self._key = jax.random.PRNGKey(config.seed)
-        self._step_count = 0
+        self._arrival_count = 0
         self._admit_count = 0
         self._num_prefills = 0
         self._num_prefill_chunks = 0
-        self._num_decode_steps = 0
+        self._num_decode_dispatches = 0
+        self._num_tokens_decoded = 0
         self._num_preemptions = 0
         self._num_cow_copies = 0
         self._prefix_hit_blocks = 0
         self._prefix_lookup_blocks = 0
         self._prompt_blocks_allocated = 0
+        # the in-flight decode dispatch: (device [B, K] tokens, device
+        # [B] counts, the lane indices it covers). Fetched — the only
+        # host sync of the decode path — at the NEXT tick, after that
+        # tick's admission/prefill work is already dispatched.
+        self._pending = None
+        # dirty-tracked device mirrors of slot-composition state: the
+        # decode block table, and the per-lane sampling/EOS/key arrays.
+        # Steady-state decode ticks reuse them without a rebuild.
+        self._dev_tables = DeviceMirror()
+        self._dev_lanes = DeviceMirror()
+        self._table_rebuilds = 0
         # the fixed program set; anything else jitted here would break
         # the compile-count contract the tests pin. Arg 1 is the cache
         # pool in every signature (donated when the runtime allows).
@@ -229,18 +294,58 @@ class InferenceEngine:
             seq_lens=seq_len, write_start=write_start)
         last = jnp.take_along_axis(
             logits, sample_idx[:, None, None], axis=1)[:, 0]   # [1, V]
-        tok = sample_tokens(last, key, temp, top_k, top_p)
+        # ``key`` is the REQUEST's key; the first generated token is
+        # token index 0 of its per-token key chain (decode continues at
+        # index 1), so schedule changes never perturb the draw
+        tok = sample_tokens(last, jax.random.fold_in(key, 0),
+                            temp, top_k, top_p)
         return cache, tok
 
     def _decode_impl(self, params, cache, tokens, tables, context_lens,
-                     key, temp, top_k, top_p):
-        logits, cache = self.model.apply(
-            params, tokens, deterministic=True, kv_cache=cache,
-            block_tables=tables,
-            cache_positions=context_lens[:, None],
-            seq_lens=context_lens + 1)
-        tok = sample_tokens(logits[:, 0], key, temp, top_k, top_p)
-        return cache, tok
+                     budgets, gen_counts, eos_ids, lane_keys, temp,
+                     top_k, top_p):
+        """K = ``decode_steps`` fused decode iterations in ONE dispatch.
+
+        Each scan step writes the carried token's K/V at the lane's
+        context position, attends through the (loop-invariant) block
+        table, samples the next token with the lane's per-token key,
+        and feeds it back. Lanes freeze — stop writing, emit ``-1`` —
+        once their remaining ``budgets`` hit zero or they sample their
+        EOS id (``eos_ids``; ``-1`` = none); a frozen lane's query
+        still rides the batch but its ``write_start`` sits one past its
+        context position, so the scatter drops. Returns the updated
+        cache and ``[B, K]`` emitted tokens — ``-1`` where nothing was
+        emitted, so each lane's count is the length of its non-sentinel
+        prefix (token ids are always ``>= 0``; the host derives counts
+        from the one fetched array instead of a second device output).
+        """
+        def body(carry, _):
+            cache, tok, ctx, budget, gcount = carry
+            act = budget > 0
+            write_start = jnp.where(act, ctx, ctx + 1)
+            logits, cache = self.model.apply(
+                params, tok[:, None], deterministic=True, kv_cache=cache,
+                block_tables=tables, cache_positions=ctx[:, None],
+                seq_lens=ctx + 1, write_start=write_start)
+            keys = jax.vmap(jax.random.fold_in)(lane_keys, gcount)
+            new = sample_tokens_per_lane(logits[:, 0], keys, temp, top_k,
+                                         top_p)
+            emitted = act.astype(jnp.int32)
+            out = jnp.where(act, new, jnp.int32(-1))
+            budget = budget - emitted
+            stop = (budget <= 0) | ((eos_ids >= 0) & (new == eos_ids))
+            cont = act & ~stop
+            # zeroing the budget on EOS folds both stop conditions into
+            # the single ``budget > 0`` activity test next iteration
+            carry = (cache, jnp.where(cont, new, tok), ctx + emitted,
+                     jnp.where(cont, budget, jnp.int32(0)),
+                     gcount + emitted)
+            return carry, out
+
+        (cache, _, _, _, _), toks = jax.lax.scan(
+            body, (cache, tokens, context_lens, budgets, gen_counts),
+            None, length=self.config.decode_steps)
+        return cache, toks.T
 
     # -- host-side scheduling ---------------------------------------------
 
@@ -259,11 +364,28 @@ class InferenceEngine:
                 f"({n} + {request.max_new_tokens}) exceeds max_seq_len "
                 f"({self.config.max_seq_len})")
         request.sampling.validate()
-        self.waiting.append(_QueueEntry(request=request))
+        self.waiting.append(_QueueEntry(request=request,
+                                        arrival=self._arrival_count))
+        self._arrival_count += 1
 
-    def _next_key(self):
-        self._step_count += 1
-        return jax.random.fold_in(self._key, self._step_count)
+    def _request_key(self, entry: _QueueEntry):
+        """The request's own PRNG key: engine seed x arrival order.
+        Token ``j`` of the request is drawn with ``fold_in(key, j)`` —
+        never from a step counter — so draws are invariant to lane
+        placement, batch composition, ``decode_steps``, and
+        preemption/resume (the re-queued entry keeps its arrival)."""
+        return jax.random.fold_in(self._key, entry.arrival)
+
+    def _invalidate_lanes(self) -> None:
+        """Slot composition changed (admit/start/finish/preempt): both
+        the decode table and the per-lane arrays must rebuild."""
+        self._dev_lanes.invalidate()
+        self._dev_tables.invalidate()
+
+    def _invalidate_tables(self) -> None:
+        """A lane's block list changed (growth/CoW): same lanes, new
+        table rows."""
+        self._dev_tables.invalidate()
 
     def _host_tables(self, decode_only: bool = False) -> np.ndarray:
         """[max_batch, max_blocks_per_seq] host tables (-1 = unmapped).
@@ -288,6 +410,32 @@ class InferenceEngine:
                                                sp.top_p)
         return (jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
 
+    def _build_decode_tables(self):
+        self._table_rebuilds += 1
+        return device_block_table(self._host_tables(decode_only=True),
+                                  self.config.num_blocks)
+
+    def _build_lane_meta(self):
+        """The slot-composition-keyed decode inputs: sampling knobs,
+        EOS ids (-1 = none), and per-request PRNG keys, one row per
+        lane (zeros/-1 for lanes that are empty or still prefilling —
+        their draws are masked to the sentinel on-device)."""
+        B = self.config.max_batch
+        temp, top_k, top_p = self._sampling_arrays(
+            [s.request.sampling if s is not None and s.started else None
+             for s in self.slots])
+        eos = np.full(B, -1, np.int32)
+        arrivals = np.zeros(B, np.int32)
+        for i, s in enumerate(self.slots):
+            if s is None or not s.started:
+                continue
+            if s.request.eos_token_id is not None:
+                eos[i] = s.request.eos_token_id
+            arrivals[i] = s.entry.arrival
+        keys = jax.vmap(lambda a: jax.random.fold_in(self._key, a))(
+            jnp.asarray(arrivals))
+        return temp, top_k, top_p, jnp.asarray(eos), keys
+
     def _finish(self, idx: int) -> None:
         """Release the slot: refs drop, and with prefix caching on the
         registered blocks stay cached (evictable) rather than freed.
@@ -299,6 +447,7 @@ class InferenceEngine:
         self.allocator.free(list(reversed(slot.blocks)))
         self.finished[slot.request.uid] = slot.generated
         self.slots[idx] = None
+        self._invalidate_lanes()
 
     def _record_token(self, idx: int, token: int) -> None:
         """Append a sampled token to a slot, finishing on EOS/max-len."""
@@ -400,6 +549,7 @@ class InferenceEngine:
                 slot.last_token = slot.generated[-1]
                 slot.started = True
             self.slots[idx] = slot
+            self._invalidate_lanes()
             admitted += 1
         return admitted
 
@@ -438,7 +588,7 @@ class InferenceEngine:
             jnp.asarray([slot.prefill_pos], jnp.int32),     # write_start
             jnp.asarray([(L - 1) - start], jnp.int32),      # sample_idx
             device_block_table(table, self.config.num_blocks),
-            self._next_key(), temp, top_k, top_p)
+            self._request_key(slot.entry), temp, top_k, top_p)
         self._num_prefill_chunks += 1
         slot.prefill_pos = end
         slot.context_len = max(slot.context_len, end)
@@ -446,6 +596,7 @@ class InferenceEngine:
         if end == L:
             self._num_prefills += 1
             slot.started = True
+            self._invalidate_lanes()
             if slot.entry.generated:
                 # resumed after preemption: the history's tokens are
                 # already emitted — never resample them
@@ -475,71 +626,155 @@ class InferenceEngine:
         # deepest-first, same as _finish: keep evictable chains matchable
         self.allocator.free(list(reversed(slot.blocks)))
         self.waiting.appendleft(_QueueEntry(request=slot.request,
+                                            arrival=slot.entry.arrival,
                                             generated=gen))
         self.slots[idx] = None
+        self._invalidate_lanes()
         self._num_preemptions += 1
         return True
 
     def _ensure_decode_blocks(self) -> None:
-        """Each started slot is about to write K/V at position
-        ``context_len`` — make sure a PRIVATE block covers it: allocate
-        at block boundaries (preempting the youngest lane if the pool
-        is dry), and copy-on-write when the covering block is shared
-        with another sequence (a full-block prefix match never shares a
-        partial tail, so CoW is a guard for exotic sharing patterns,
-        not the steady state)."""
+        """Each started slot is about to write K/V at positions
+        ``context_len .. context_len + span - 1`` (``span`` = the
+        coming dispatch's emitted-token bound: ``decode_steps`` capped
+        by the lane's remaining budget) — make sure PRIVATE blocks
+        cover the whole span: allocate the missing tail (preempting the
+        youngest lane if the pool is dry), and copy-on-write any
+        covering block shared with another sequence (a full-block
+        prefix match never shares a partial tail, so CoW is a guard for
+        exotic sharing patterns, not the steady state). Reserving the
+        span UP FRONT keeps the scan free of host intervention: a
+        mid-scan allocation failure is impossible, so preemption
+        granularity is K tokens, decided before the dispatch."""
         bs = self.config.block_size
+        K = self.config.decode_steps
         order = sorted((s.admit_seq, i) for i, s in enumerate(self.slots)
                        if s is not None and s.started)
         for _, i in order:
             while self.slots[i] is not None:
                 slot = self.slots[i]
-                need = blocks_needed(slot.context_len + 1, bs)
+                span = min(K, slot.request.max_new_tokens
+                           - len(slot.generated))
+                need = blocks_needed(slot.context_len + span, bs)
                 if len(slot.blocks) < need:
                     try:
-                        slot.blocks.extend(self.allocator.alloc(1))
+                        slot.blocks.extend(
+                            self.allocator.alloc(need - len(slot.blocks)))
+                        self._invalidate_tables()
                     except CacheOutOfBlocks:
                         if not self._preempt_for(i):
                             raise CacheOutOfBlocks(
                                 f"request {slot.request.uid!r} cannot grow "
                                 f"past {slot.context_len} cached tokens: "
-                                f"0 blocks available of "
+                                f"{self.allocator.num_free} blocks free of "
                                 f"{self.allocator.num_blocks} and no other "
                                 "lane left to preempt")
                     continue   # re-check: the slot itself may be gone
-                b = slot.blocks[slot.context_len // bs]
-                if self.allocator.refcount(b) > 1:
-                    try:
-                        nb = self.allocator.alloc(1)[0]
-                    except CacheOutOfBlocks:
-                        if not self._preempt_for(i):
-                            raise CacheOutOfBlocks(
-                                f"request {slot.request.uid!r}: cannot "
-                                "copy-on-write a shared block, pool "
-                                "exhausted and no lane left to preempt")
-                        continue
-                    self.cache = self._cow(self.cache,
-                                           jnp.int32(b), jnp.int32(nb))
-                    self.allocator.free([b])
-                    slot.blocks[slot.context_len // bs] = nb
-                    # the copy diverges from the indexed contents the
-                    # moment we append; registration state stays with
-                    # the ORIGINAL block
-                    if slot.num_registered > slot.context_len // bs:
-                        slot.num_registered = slot.context_len // bs
-                    self._num_cow_copies += 1
-                break
+                first = slot.context_len // bs
+                last = (slot.context_len + span - 1) // bs
+                j = next((j for j in range(first, last + 1)
+                          if self.allocator.refcount(slot.blocks[j]) > 1),
+                         None)
+                if j is None:
+                    break
+                try:
+                    nb = self.allocator.alloc(1)[0]
+                except CacheOutOfBlocks:
+                    if not self._preempt_for(i):
+                        raise CacheOutOfBlocks(
+                            f"request {slot.request.uid!r}: cannot "
+                            "copy-on-write a shared block, pool "
+                            "exhausted and no lane left to preempt")
+                    continue
+                b = slot.blocks[j]
+                self.cache = self._cow(self.cache,
+                                       jnp.int32(b), jnp.int32(nb))
+                self.allocator.free([b])
+                slot.blocks[j] = nb
+                self._invalidate_tables()
+                # the copy diverges from the indexed contents the
+                # moment we append; registration state stays with
+                # the ORIGINAL block
+                if slot.num_registered > j:
+                    slot.num_registered = j
+                self._num_cow_copies += 1
+                # loop again: the span may cross FURTHER shared blocks
+
+    # -- the fused decode dispatch + deferred drain ------------------------
+
+    def _dispatch_decode(self, active: List[int]) -> None:
+        """Launch the K-step fused decode for ``active`` lanes and
+        leave the result in flight (``self._pending``). Only the small
+        per-tick arrays (tokens, context lens, budgets, counts) upload
+        here; the block table and lane meta come from their mirrors."""
+        B = self.config.max_batch
+        tokens = np.zeros(B, np.int32)
+        ctx = np.zeros(B, np.int32)
+        budgets = np.zeros(B, np.int32)
+        gcounts = np.zeros(B, np.int32)
+        for i in active:
+            slot = self.slots[i]
+            tokens[i] = slot.last_token
+            ctx[i] = slot.context_len
+            budgets[i] = (slot.request.max_new_tokens
+                          - len(slot.generated))
+            gcounts[i] = len(slot.generated)
+        tables = self._dev_tables.get(self._build_decode_tables)
+        temp, top_k, top_p, eos, keys = self._dev_lanes.get(
+            self._build_lane_meta)
+        self.cache, toks = self._decode(
+            self.params, self.cache, jnp.asarray(tokens), tables,
+            jnp.asarray(ctx), jnp.asarray(budgets), jnp.asarray(gcounts),
+            eos, keys, temp, top_k, top_p)
+        self._num_decode_dispatches += 1
+        self._pending = (toks, list(active))
+
+    def _drain_decode(self) -> bool:
+        """The deferred host sync: fetch the in-flight dispatch's
+        ``[B, K]`` tokens (the ONLY decode-path block on the device)
+        and replay them through the per-token bookkeeping —
+        cache-token append, block registration, EOS/budget finish. The
+        device's stop mask mirrors ``_record_token`` exactly, so a lane
+        that froze mid-scan finishes here on the same token."""
+        if self._pending is None:
+            return False
+        toks, active = self._pending
+        self._pending = None
+        toks = np.asarray(toks)
+        # each lane's emitted tokens are its non-sentinel prefix (lanes
+        # freeze permanently mid-scan, and real token ids are >= 0)
+        counts = (toks >= 0).sum(axis=1)
+        for i in active:
+            slot = self.slots[i]
+            for j in range(int(counts[i])):
+                slot.tokens.append(slot.last_token)   # its K/V landed
+                slot.context_len += 1
+                self._register_full_blocks(slot)
+                self._record_token(i, int(toks[i, j]))
+                if self.slots[i] is None:
+                    break
+            self._num_tokens_decoded += int(counts[i])
+        return True
 
     def step(self) -> None:
         """One scheduler tick: admit, run at most one prefill chunk,
-        then one decode step for every started slot (if any)."""
+        drain the previous tick's in-flight decode, then dispatch one
+        fused K-step decode for every started slot (if any). The drain
+        comes AFTER admission/prefill on purpose — tick t+1's host
+        scheduling work overlaps tick t's device decode (the deferred
+        sync) — with an admission top-up behind it so lanes freed by
+        the drain don't idle a tick."""
         admitted = self._admit()
         chunked = self._prefill_tick()
+        synced = self._drain_decode()
+        if synced:
+            admitted += self._admit()
         if all(s is None for s in self.slots):
-            if self.waiting and not admitted and not chunked:
-                # zero live sequences means nothing will ever free a
-                # block — the queue head can never be admitted (the
-                # pool is undersized for it). Raise, don't spin.
+            if self.waiting and not admitted and not chunked and not synced:
+                # zero live sequences and nothing in flight means
+                # nothing will ever free a block — the queue head can
+                # never be admitted (the pool is undersized for it).
+                # Raise, don't spin.
                 entry = self.waiting[0]
                 need = blocks_needed(len(entry.request.prompt) + 1,
                                      self.config.block_size)
@@ -558,33 +793,23 @@ class InferenceEngine:
                   if s is not None and s.started]
         if not active:
             return
-        B = self.config.max_batch
-        tokens = np.zeros((B, 1), np.int32)
-        ctx = np.zeros((B,), np.int32)
-        for i in active:
-            tokens[i, 0] = self.slots[i].last_token
-            ctx[i] = self.slots[i].context_len
-        temp, top_k, top_p = self._sampling_arrays(
-            [s.request.sampling if s is not None and s.started else None
-             for s in self.slots])
-        self.cache, toks = self._decode(
-            self.params, self.cache, jnp.asarray(tokens),
-            device_block_table(self._host_tables(decode_only=True),
-                               self.config.num_blocks),
-            jnp.asarray(ctx), self._next_key(), temp, top_k, top_p)
-        self._num_decode_steps += 1
-        toks = np.asarray(toks)
-        for i in active:
-            slot = self.slots[i]
-            slot.tokens.append(slot.last_token)   # its K/V just landed
-            slot.context_len += 1
-            self._register_full_blocks(slot)
-            self._record_token(i, int(toks[i]))
+        self._dispatch_decode(active)
+
+    @property
+    def has_work(self) -> bool:
+        """True while anything is queued, resident in a lane, or IN
+        FLIGHT (an undrained decode dispatch). This is ``run()``'s loop
+        condition, public so external step-at-a-time drivers (bench.py
+        samples utilization per tick) drain completely without
+        duplicating it — a hand-rolled ``waiting or slots`` check would
+        silently drop the last dispatch's tokens."""
+        return (bool(self.waiting) or self._pending is not None
+                or any(s is not None for s in self.slots))
 
     def run(self) -> Dict[str, List[int]]:
-        """Drain: step until every queued and active request finishes.
-        Returns ``{uid: generated_token_ids}``."""
-        while self.waiting or any(s is not None for s in self.slots):
+        """Drain: step until every queued, active, and in-flight
+        request finishes. Returns ``{uid: generated_token_ids}``."""
+        while self.has_work:
             self.step()
         out, self.finished = self.finished, {}
         return out
@@ -597,7 +822,16 @@ class InferenceEngine:
             "decode_compilations": self._decode._cache_size(),
             "num_prefills": self._num_prefills,
             "num_prefill_chunks": self._num_prefill_chunks,
-            "num_decode_steps": self._num_decode_steps,
+            "num_decode_dispatches": self._num_decode_dispatches,
+            # tokens actually emitted by decode dispatches (drained
+            # ones; an in-flight dispatch counts after its sync). The
+            # dispatches:tokens ratio is the multi-step amortization.
+            "num_tokens_decoded": self._num_tokens_decoded,
+            # back-compat alias: pre-multistep dashboards/tests read
+            # num_decode_steps, which meant DISPATCHES (at K=1 the two
+            # were indistinguishable)
+            "num_decode_steps": self._num_decode_dispatches,
+            "decode_table_rebuilds": self._table_rebuilds,
             "num_preemptions": self._num_preemptions,
             "num_cow_copies": self._num_cow_copies,
             "num_cache_evictions": alloc.num_evictions,

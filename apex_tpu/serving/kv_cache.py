@@ -299,6 +299,47 @@ def blocks_needed(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))
 
 
+class DeviceMirror:
+    """A dirty-tracked host→device buffer: the device copy of host state
+    that changes RARELY relative to how often it is consumed.
+
+    The serving engine ships a ``[max_batch, max_blocks_per_seq]`` block
+    table and a handful of per-lane sampling arrays into every decode
+    dispatch. Their contents change only when the SLOT COMPOSITION
+    changes (admission, finish, preemption, block growth) — not on the
+    steady-state tick — yet the pre-mirror engine rebuilt and re-uploaded
+    them from scratch every ``step()``. A mirror caches the built device
+    value and rebuilds only after :meth:`invalidate`:
+
+        mirror.get(build_fn)   # cached device value, or build_fn() once
+        mirror.invalidate()    # host state changed; next get() rebuilds
+
+    Pure host-side bookkeeping (no jax calls of its own): ``build_fn``
+    owns the upload, the mirror owns only the decision to skip it. The
+    scheduler invalidates at its mutation points; forgetting one is a
+    correctness bug (a stale table scatters K/V into freed blocks), so
+    mutation sites funnel through the engine's ``_invalidate_*``
+    helpers rather than touching mirrors directly.
+    """
+
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = None
+
+    @property
+    def dirty(self) -> bool:
+        return self._value is None
+
+    def invalidate(self) -> None:
+        self._value = None
+
+    def get(self, build):
+        if self._value is None:
+            self._value = build()
+        return self._value
+
+
 def device_block_table(host_tables: np.ndarray, num_blocks: int) -> jax.Array:
     """Host tables use -1 for unallocated entries; the device convention
     is ``num_blocks`` (one past the pool) so scatters drop and gathers

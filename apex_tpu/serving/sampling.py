@@ -11,9 +11,18 @@ inside the engine's two jitted programs (a separately-jitted sampler
 would be a third compilation, breaking the two-program contract
 documented in docs/serving.md).
 
-Randomness is a threaded ``jax.random`` key: the engine folds its step
-counter into a base key per step, so a fixed engine seed reproduces a
-generation bit-for-bit (the determinism contract tests rely on).
+Two entry points share one filtering chain:
+
+- :func:`sample_tokens` — one PRNG key for the whole batch. A row's
+  draw still depends on its ROW INDEX (the key's Gumbel noise is laid
+  out per row), so it is only reproducible while batch composition is
+  fixed — fine for standalone use and the prefill path (``B == 1``).
+- :func:`sample_tokens_per_lane` — one PRNG key PER ROW. A row's draw
+  depends only on its own key and logits, never on which lane it
+  occupies or what else shares the batch. The engine keys each lane by
+  ``fold_in(request_key, token_index)``, which is what makes generation
+  bit-for-bit identical across ``decode_steps`` settings, lane
+  placements, and preemption/resume schedules (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -32,6 +41,13 @@ class SamplingParams:
     disables the top-k filter; ``top_p >= 1`` disables nucleus
     filtering. Filters compose: top-k first, then top-p over what
     survives, matching the common serving convention.
+
+    ``top_k`` values at or above the vocabulary size are equivalent to
+    ``top_k = 0`` (disabled): the filter keeps the ``top_k``
+    best-ranked tokens, and every token ranks inside ``top_k >= V``.
+    ``validate()`` cannot clamp this — the vocabulary size is a model
+    property the params object never sees — so the equivalence is the
+    contract instead (regression-tested in tests/test_serving.py).
     """
 
     temperature: float = 0.0
@@ -46,18 +62,12 @@ class SamplingParams:
         return self
 
 
-def sample_tokens(logits, key, temperature, top_k, top_p):
-    """Draw one token per row.
-
-    Args:
-      logits: ``[B, V]`` (any float dtype; filtering runs in fp32).
-      key: a single PRNG key; rows draw independent categorical samples.
-      temperature: ``[B]`` fp32; ``<= 0`` means greedy for that row.
-      top_k: ``[B]`` int32; ``<= 0`` disables.
-      top_p: ``[B]`` fp32 nucleus mass; ``>= 1`` disables.
-
-    Returns ``[B]`` int32 token ids.
-    """
+def _filtered_sorted_logits(logits, temperature, top_k, top_p):
+    """The shared filtering chain: temperature-scale, sort descending,
+    mask by top-k rank and top-p mass. Returns ``(filtered, order,
+    greedy)`` where ``filtered`` are the sorted scaled logits with
+    killed positions at ``-inf``, ``order`` maps sorted rank back to
+    vocabulary id, and ``greedy`` is the plain argmax per row."""
     lg = logits.astype(jnp.float32)
     V = lg.shape[-1]
     greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -69,6 +79,7 @@ def sample_tokens(logits, key, temperature, top_k, top_p):
     order = jnp.argsort(-scaled, axis=-1)               # [B, V]
     sorted_lg = jnp.take_along_axis(scaled, order, axis=-1)
     rank = jax.lax.broadcasted_iota(jnp.int32, sorted_lg.shape, 1)
+    # top_k >= V keeps every rank — the documented "disabled" alias
     k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
     keep_k = rank < k_eff
     # nucleus mass is measured over the RENORMALIZED top-k survivors
@@ -80,8 +91,50 @@ def sample_tokens(logits, key, temperature, top_k, top_p):
     # is under top_p, so the first token always survives
     cum_before = jnp.cumsum(probs, axis=-1) - probs
     keep = keep_k & (cum_before < top_p[:, None])
-    filtered = jnp.where(keep, sorted_lg, -jnp.inf)
+    return jnp.where(keep, sorted_lg, -jnp.inf), order, greedy
 
+
+def sample_tokens(logits, key, temperature, top_k, top_p):
+    """Draw one token per row from a single shared key.
+
+    Args:
+      logits: ``[B, V]`` (any float dtype; filtering runs in fp32).
+      key: a single PRNG key; rows draw independent categorical samples.
+      temperature: ``[B]`` fp32; ``<= 0`` means greedy for that row.
+      top_k: ``[B]`` int32; ``<= 0`` (or ``>= V``) disables.
+      top_p: ``[B]`` fp32 nucleus mass; ``>= 1`` disables.
+
+    Returns ``[B]`` int32 token ids.
+    """
+    filtered, order, greedy = _filtered_sorted_logits(
+        logits, temperature, top_k, top_p)
     pos = jax.random.categorical(key, filtered, axis=-1)
+    sampled = jnp.take_along_axis(order, pos[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def sample_tokens_per_lane(logits, keys, temperature, top_k, top_p):
+    """Draw one token per row, each row from ITS OWN key.
+
+    Same filtering semantics as :func:`sample_tokens`; the difference is
+    reproducibility scope. Row ``i`` draws ``categorical(keys[i],
+    filtered[i])`` — no row-index dependence, no cross-row coupling —
+    so a sequence keyed by per-request/per-token keys samples the same
+    token no matter which batch lane it rides in, how many other lanes
+    are live, or how many scan steps the dispatch fuses. This is the
+    decode-side sampler of the multi-step fused decode program
+    (docs/serving.md).
+
+    Args:
+      logits: ``[B, V]``.
+      keys: ``[B]`` PRNG keys (a ``[B, 2]`` uint32 array for the
+        threefry impl), one per row.
+      temperature / top_k / top_p: as in :func:`sample_tokens`.
+
+    Returns ``[B]`` int32 token ids.
+    """
+    filtered, order, greedy = _filtered_sorted_logits(
+        logits, temperature, top_k, top_p)
+    pos = jax.vmap(jax.random.categorical)(keys, filtered)
     sampled = jnp.take_along_axis(order, pos[:, None], axis=-1)[:, 0]
     return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)

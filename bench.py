@@ -839,11 +839,14 @@ def bench_serving():
     t0 = time.perf_counter()
     for r in requests("dec", max_new):
         engine.add_request(r)
-    while engine.waiting or any(s is not None for s in engine.slots):
+    while engine.has_work:
         engine.step()
         util_peak = max(util_peak, engine.allocator.utilization)
     dt = time.perf_counter() - t0
-    decode_steps = engine.stats()["num_decode_steps"] - s0["num_decode_steps"]
+    decode_steps = (engine.stats()["num_decode_dispatches"]
+                    - s0["num_decode_dispatches"])
+    decode_tokens = (engine.stats()["num_tokens_decoded"]
+                     - s0["num_tokens_decoded"])
     stats = engine.stats()
 
     # phase 3 — prefix caching (round 6): decode tokens/s over a fresh
@@ -919,6 +922,7 @@ def bench_serving():
         # no reference arm for serving yet — recorded against itself
         "vs_baseline": 1.0,
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "decode_tokens_per_sec": round(decode_tokens / max(dt, 1e-9), 3),
         "cache_slot_utilization_peak": round(util_peak, 3),
         "jit_programs": int(stats["prefill_compilations"]
                             + stats["decode_compilations"]),
@@ -928,6 +932,105 @@ def bench_serving():
             k: (round(v, 4) if isinstance(v, float) else int(v))
             for k, v in s90.items()
         },
+    }
+
+
+def bench_serving_multistep(fast=False):
+    """Multi-step fused decode sweep: the same decode-dominated
+    workload served at ``decode_steps`` (K) in {1, 4, 8} — K scanned
+    decode iterations per dispatch, so one scheduler tick (host table /
+    sampling-array work, dispatch, fetch) is amortized over K tokens
+    per lane. Reports decode tokens/sec per arm plus the dispatch vs
+    token counters that make the amortization observable, and ASSERTS
+    the outputs are bit-identical across K (the per-request/per-token
+    PRNG keying contract — a throughput knob must never change what
+    gets generated). ``vs_baseline`` is the K=max / K=1 tokens/sec
+    ratio: the multi-step speedup itself. ``fast=True`` is the tier-1
+    smoke shape (smaller sweep + workload, same code path)."""
+    import dataclasses as _dc
+
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
+                                  SamplingParams)
+
+    on_tpu = jax.default_backend() == "tpu" and not fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ecfg = EngineConfig(max_batch=16, block_size=32, num_blocks=512,
+                            max_prefill_len=256, max_seq_len=512,
+                            kv_dtype=jnp.bfloat16)
+        n_req, max_new, prompt_len = 16, 64, 32
+        ks = (1, 4, 8)
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ecfg = EngineConfig(max_batch=4, block_size=8, num_blocks=64,
+                            max_prefill_len=16, max_seq_len=48)
+        n_req, max_new, prompt_len = (4, 12, 8) if fast else (8, 24, 8)
+        ks = (1, 4) if fast else (1, 4, 8)
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.RandomState(_SALT + 1)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))
+    # mixed greedy / sampled lanes, fixed across arms (the bit-identity
+    # check is only meaningful when every arm serves the same stream)
+    prompts = [list(rng.randint(0, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+
+    def requests(tag):
+        return [
+            Request(uid=f"{tag}-{i}", prompt=prompts[i],
+                    max_new_tokens=max_new,
+                    sampling=(SamplingParams() if i % 2 == 0 else
+                              SamplingParams(temperature=1.0, top_k=40)))
+            for i in range(n_req)
+        ]
+
+    sweep, outputs = {}, {}
+    for k in ks:
+        eng = InferenceEngine(model, params,
+                              _dc.replace(ecfg, decode_steps=k))
+        for r in requests("warm")[:2]:      # compile outside the clock
+            eng.add_request(r)
+        eng.run()
+        s0 = eng.stats()
+        t0 = time.perf_counter()
+        for r in requests(f"k{k}"):
+            eng.add_request(r)
+        out = eng.run()
+        tdt = time.perf_counter() - t0
+        s1 = eng.stats()
+        toks = s1["num_tokens_decoded"] - s0["num_tokens_decoded"]
+        sweep[f"k{k}"] = {
+            "decode_tokens_per_sec": round(toks / max(tdt, 1e-9), 3),
+            "num_decode_dispatches": int(s1["num_decode_dispatches"]
+                                         - s0["num_decode_dispatches"]),
+            "num_tokens_decoded": int(toks),
+            "decode_table_rebuilds": int(s1["decode_table_rebuilds"]
+                                         - s0["decode_table_rebuilds"]),
+            "decode_compilations": int(s1["decode_compilations"]),
+        }
+        outputs[k] = {u.split("-", 1)[1]: v for u, v in out.items()}
+
+    identical = all(outputs[k] == outputs[ks[0]] for k in ks)
+    ratio = (sweep[f"k{ks[-1]}"]["decode_tokens_per_sec"]
+             / max(sweep["k1"]["decode_tokens_per_sec"], 1e-9))
+    print("# serving multistep: " + " | ".join(
+        f"K={k} {sweep[f'k{k}']['decode_tokens_per_sec']:.1f} tok/s "
+        f"({sweep[f'k{k}']['num_decode_dispatches']} dispatches)"
+        for k in ks) + f" | K{ks[-1]}/K1 {ratio:.2f}x | "
+        f"bit-identical {identical}", file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_multistep_decode_tokens_per_sec"
+                   if on_tpu else
+                   "serving_tiny_smoke_multistep_decode_tokens_per_sec"),
+        "value": sweep[f"k{ks[-1]}"]["decode_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(ratio, 3),     # K=max vs K=1, same workload
+        "decode_steps_swept": list(ks),
+        "outputs_bit_identical_across_k": bool(identical),
+        "sweep": sweep,
     }
 
 
@@ -976,7 +1079,7 @@ def main():
     # long-context attention record (S=4096 on TPU by default; add
     # S=2048 with --long-context)
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
-                 bench_serving]
+                 bench_serving, bench_serving_multistep]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

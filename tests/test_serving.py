@@ -607,6 +607,151 @@ def test_stats_reports_block_accounting_and_scheduler_counters():
     assert 0.0 <= stats["prefix_cache_hit_rate"] <= 1.0
 
 
+def _multistep_engine(model, params, k, seed=11, **kw):
+    base = dict(max_batch=4, block_size=8, num_blocks=64,
+                max_prefill_len=16, max_seq_len=64, seed=seed,
+                decode_steps=k)
+    base.update(kw)
+    return InferenceEngine(model, params, EngineConfig(**base))
+
+
+def _multistep_workload(engine):
+    """6 staggered requests, mixed greedy/sampled, generation budgets
+    deliberately NOT multiples of 4 or 8 so lanes finish mid-scan."""
+    rng = np.random.RandomState(37)
+    reqs = []
+    for i in range(6):
+        samp = (SamplingParams() if i % 2 == 0 else
+                SamplingParams(temperature=0.9, top_k=12, top_p=0.85))
+        reqs.append(Request(uid=f"m{i}",
+                            prompt=list(rng.randint(0, 128, 4 + 2 * i)),
+                            max_new_tokens=3 + (i % 3) * 5,
+                            sampling=samp))
+    for r in reqs[:3]:
+        engine.add_request(r)
+    engine.step()
+    engine.step()
+    for r in reqs[3:]:
+        engine.add_request(r)
+    return reqs, engine.run()
+
+
+def test_multistep_decode_outputs_identical_across_k():
+    """THE multi-step acceptance scenario: greedy AND seeded-sampled
+    outputs are bit-identical for decode_steps in {1, 4, 8} (per-
+    request/per-token PRNG keys make generation schedule-invariant),
+    the compile contract stays one prefill + one decode program, and
+    K > 1 actually amortizes dispatches over tokens."""
+    cfg, model, params = _tiny_model()
+    outs, stats = {}, {}
+    for k in (1, 4, 8):
+        engine = _multistep_engine(model, params, k)
+        _, outs[k] = _multistep_workload(engine)
+        s = engine.stats()
+        assert s["prefill_compilations"] == 1
+        assert s["decode_compilations"] == 1
+        assert engine.allocator.num_used == 0
+        stats[k] = s
+    assert outs[1] == outs[4] == outs[8]
+    # same tokens, fewer dispatches: the amortization is observable
+    assert (stats[1]["num_tokens_decoded"] == stats[4]["num_tokens_decoded"]
+            == stats[8]["num_tokens_decoded"])
+    assert stats[4]["num_decode_dispatches"] < stats[1]["num_decode_dispatches"]
+    assert stats[8]["num_decode_dispatches"] <= stats[4]["num_decode_dispatches"]
+    # and the sampled half still actually depends on the engine seed
+    _, alt = _multistep_workload(_multistep_engine(model, params, 8,
+                                                   seed=999))
+    sampled = [f"m{i}" for i in range(6) if i % 2 == 1]
+    assert any(alt[u] != outs[8][u] for u in sampled)
+
+
+def test_multistep_eos_and_budget_freeze_lanes_mid_scan():
+    """A lane that samples EOS (or exhausts max_new_tokens) mid-scan
+    must freeze on-device — later scan iterations emit the sentinel and
+    write nothing — and the host must finish it on exactly the same
+    token a K=1 engine would."""
+    cfg, model, params = _tiny_model()
+    prompt = list(np.random.RandomState(31).randint(0, 128, 6))
+    pilot = _multistep_engine(model, params, 1)
+    pilot.add_request(Request(uid="p", prompt=prompt, max_new_tokens=6))
+    ref = pilot.run()["p"]
+
+    # eos on (the first occurrence of) the 4th greedy token: fires on
+    # scan iteration 2 or 3 of the single K=8 dispatch
+    eos = int(ref[3])
+    expected = ref[: ref.index(eos) + 1]
+    engine = _multistep_engine(model, params, 8)
+    engine.add_request(Request(uid="e", prompt=prompt, max_new_tokens=6,
+                               eos_token_id=eos))
+    engine.add_request(Request(uid="b", prompt=prompt, max_new_tokens=6))
+    out = engine.run()
+    assert out["e"] == expected
+    assert out["b"] == ref
+    stats = engine.stats()
+    # both lanes' whole generation fits inside single K=8 dispatches
+    # (budget 5 < 8 after the prefill-sampled first token)
+    total_decode = (len(expected) - 1) + (len(ref) - 1)
+    assert stats["num_tokens_decoded"] == total_decode
+    assert stats["num_decode_dispatches"] <= 2
+    assert stats["decode_compilations"] == 1
+    assert engine.allocator.num_used == 0
+
+
+def test_multistep_preemption_resume_is_deterministic():
+    """Preemption-under-pressure at K=4, with a SAMPLED lane in the
+    mix: a pool tight enough to force preemption (granularity is now K
+    tokens of block headroom) must emit byte-identical tokens to a
+    roomy pool — and to a roomy K=1 engine — because emitted tokens are
+    carried across preemption and per-token keys make the resumed
+    sampling continue the same draw sequence."""
+    cfg, model, params = _tiny_model()
+    rng = np.random.RandomState(19)
+    reqs = [Request(uid=f"r{i}", prompt=list(rng.randint(0, 128, 6 + i)),
+                    max_new_tokens=20,
+                    sampling=(SamplingParams(temperature=0.8, top_k=12)
+                              if i == 1 else SamplingParams()))
+            for i in range(3)]
+
+    def serve(num_blocks, k):
+        engine = InferenceEngine(model, params, EngineConfig(
+            max_batch=3, block_size=8, num_blocks=num_blocks,
+            max_prefill_len=8, max_seq_len=32, decode_steps=k, seed=5))
+        for r in reqs:
+            engine.add_request(r)
+        return engine.run(), engine.stats()
+
+    roomy, roomy_stats = serve(num_blocks=16, k=4)
+    tight, tight_stats = serve(num_blocks=6, k=4)
+    single, single_stats = serve(num_blocks=16, k=1)
+    assert roomy_stats["num_preemptions"] == 0
+    assert tight_stats["num_preemptions"] >= 1
+    assert tight == roomy == single
+    for s in (roomy_stats, tight_stats, single_stats):
+        assert s["prefill_compilations"] == 1
+        assert s["decode_compilations"] == 1
+
+
+def test_stats_split_decode_dispatches_from_tokens_with_alias():
+    """stats() reports num_decode_dispatches and num_tokens_decoded
+    separately; the legacy num_decode_steps key survives as an alias
+    for dispatches (its pre-multistep meaning)."""
+    cfg, model, params = _tiny_model()
+    engine = _multistep_engine(model, params, 4)
+    for uid in ("a", "b"):
+        engine.add_request(Request(uid=uid, prompt=[3, 1, 4, 1, 5],
+                                   max_new_tokens=9))
+    out = engine.run()
+    stats = engine.stats()
+    # every generated token past the prefill-sampled first one came
+    # from a decode dispatch
+    decode_tokens = sum(len(v) - 1 for v in out.values())
+    assert stats["num_tokens_decoded"] == decode_tokens
+    assert stats["num_decode_steps"] == stats["num_decode_dispatches"]
+    assert stats["num_decode_dispatches"] < stats["num_tokens_decoded"]
+    # the dirty-tracked table uploaded at most once per dispatch
+    assert stats["decode_table_rebuilds"] <= stats["num_decode_dispatches"]
+
+
 def test_engine_raises_when_pool_can_never_serve_the_queue():
     """A request whose prompt needs more blocks than the whole pool must
     raise CacheOutOfBlocks instead of spinning the scheduler forever."""
@@ -663,6 +808,101 @@ def test_sampling_greedy_topk_topp_determinism():
             jnp.full((4,), k, jnp.int32), ones))
         for row in range(4):
             assert toks[row] in topk_sets[row]
+
+
+def test_sampling_top_k_at_least_vocab_equals_disabled():
+    """The documented alias: top_k >= V keeps every rank, so it must
+    draw exactly what top_k = 0 (disabled) draws under the same key —
+    and validate() must accept it (it cannot clamp: the vocabulary size
+    is a model property the params object never sees)."""
+    rng = np.random.RandomState(2)
+    V = 32
+    logits = jnp.asarray(rng.randn(4, V).astype("f4") * 2.0)
+    ones = jnp.ones((4,), jnp.float32)
+    SamplingParams(temperature=1.0, top_k=10 ** 6).validate()
+    for s in range(8):
+        key = jax.random.PRNGKey(s)
+        ref = np.asarray(sample_tokens(logits, key, ones,
+                                       jnp.zeros((4,), jnp.int32), ones))
+        for k in (V, V + 1, 10 ** 6):
+            got = np.asarray(sample_tokens(
+                logits, key, ones, jnp.full((4,), k, jnp.int32), ones))
+            np.testing.assert_array_equal(got, ref)
+
+
+def test_sample_tokens_per_lane_draws_are_lane_invariant():
+    """The property the multi-step decode keys rely on: a row's draw
+    depends only on ITS key and logits — permuting the batch permutes
+    the draws, it never changes them (the shared-key sampler folds the
+    row index into the noise, so this deliberately does NOT hold for
+    sample_tokens)."""
+    from apex_tpu.serving import sample_tokens_per_lane
+
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(3, 64).astype("f4") * 2.0)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in (100, 101, 102)])
+    ones = jnp.ones((3,), jnp.float32)
+    zeros_i = jnp.zeros((3,), jnp.int32)
+    out = np.asarray(sample_tokens_per_lane(logits, keys, ones * 1.5,
+                                            zeros_i, ones))
+    perm = np.array([2, 0, 1])
+    out_p = np.asarray(sample_tokens_per_lane(
+        logits[perm], keys[perm], ones * 1.5, zeros_i, ones))
+    np.testing.assert_array_equal(out_p, out[perm])
+    # greedy rows ignore the key entirely
+    greedy = np.asarray(sample_tokens_per_lane(
+        logits, keys, jnp.zeros((3,)), zeros_i, ones))
+    np.testing.assert_array_equal(greedy,
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_device_mirror_rebuilds_only_after_invalidate():
+    from apex_tpu.serving import DeviceMirror
+
+    calls = []
+
+    def build():
+        calls.append(1)
+        return len(calls)
+
+    m = DeviceMirror()
+    assert m.dirty
+    assert m.get(build) == 1 and m.get(build) == 1 and len(calls) == 1
+    assert not m.dirty
+    m.invalidate()
+    assert m.dirty
+    assert m.get(build) == 2 and len(calls) == 2
+
+
+def test_bench_serving_multistep_section_smoke():
+    """The bench serving section's decode_steps sweep (fast shape) must
+    run end-to-end, report the new dispatch/token counters per arm, and
+    certify bit-identical outputs across K."""
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+    spec = importlib.util.spec_from_file_location("_bench_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.bench_serving_multistep(fast=True)
+    assert rec["unit"] == "tokens/sec"
+    assert rec["outputs_bit_identical_across_k"] is True
+    assert rec["decode_steps_swept"] == [1, 4]
+    sweep = rec["sweep"]
+    assert set(sweep) == {"k1", "k4"}
+    for arm in sweep.values():
+        for key in ("decode_tokens_per_sec", "num_decode_dispatches",
+                    "num_tokens_decoded", "decode_table_rebuilds",
+                    "decode_compilations"):
+            assert key in arm, key
+        assert arm["decode_compilations"] == 1
+        assert arm["decode_tokens_per_sec"] > 0
+    assert (sweep["k4"]["num_decode_dispatches"]
+            < sweep["k1"]["num_decode_dispatches"])
+    assert (sweep["k4"]["num_tokens_decoded"]
+            == sweep["k1"]["num_tokens_decoded"])
+    assert rec["vs_baseline"] > 0
 
 
 def test_sampling_top_p_renormalizes_over_top_k_survivors():
