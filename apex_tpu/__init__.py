@@ -24,6 +24,10 @@ A from-scratch rebuild of the capability surface of NVIDIA Apex
 - ``apex_tpu.serving``    — the inference leg (beyond the reference's
   training-only surface): paged KV-cache, continuous-batching
   prefill/decode engine, jit-stable sampling (docs/serving.md).
+- ``apex_tpu.train``      — the composed training step: amp + scanned
+  gradient accumulation + DDP + fused optimizer compiled into one
+  donated-buffer dispatch, with deferred host metrics
+  (docs/training.md).
 
 Design stance (SURVEY.md §7): a functional JAX core with an apex-shaped API
 veneer — capability and knob parity with the reference, mesh/pjit-native
@@ -43,3 +47,4 @@ from apex_tpu import reparameterization  # noqa: F401
 from apex_tpu import RNN  # noqa: F401
 from apex_tpu import fused_dense  # noqa: F401
 from apex_tpu import serving  # noqa: F401
+from apex_tpu import train  # noqa: F401

@@ -43,6 +43,21 @@ class AmpHandle:
     def init_state(self, loss_id: int = 0) -> ScalerState:
         return self.scalers[loss_id].init()
 
+    def scaler(self, loss_id: int = 0) -> LossScaler:
+        """The resolved :class:`LossScaler` for ``loss_id`` — the piece a
+        step builder (``apex_tpu.train``) threads through its jitted
+        program, so scaler STATE rides the donated carry while the
+        scaler CONFIG stays a static closure."""
+        return self.scalers[loss_id]
+
+    def traced(self, loss_fn):
+        """Public form of the opt-level trace wrapper: returns
+        ``loss_fn`` traced under autocast when this opt level patches
+        functions (O1), unchanged otherwise. Step builders use this to
+        bake the whitelist/blacklist casts into their scan body without
+        reaching into handle internals."""
+        return self._traced(loss_fn)
+
     def _traced(self, loss_fn):
         """Trace loss_fn under autocast when this opt level patches
         functions (O1), so whitelist/blacklist casts bake into the

@@ -24,7 +24,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.utils.pytree import tree_cast, tree_select
+from apex_tpu.utils.pytree import tree_select
 
 
 def leaves_of(tree):
@@ -56,7 +56,20 @@ class FusedOptimizer:
     def _master_init(self, params):
         if not self.master_weights:
             return None
-        return tree_cast(params, jnp.float32)
+
+        def to_master(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+                return x.astype(jnp.float32)
+            # Already-f32 leaves (keep_batchnorm_fp32 norms) and integer
+            # leaves MUST still get their own buffer: astype is a no-op
+            # returning the same array, and a donated train state holding
+            # (params, master) would then donate one buffer twice — a
+            # runtime error on XLA:CPU/PJRT (and on a replicated mesh the
+            # non-raising ranks hang at the next collective rendezvous).
+            return jnp.array(x, copy=True)
+
+        return jax.tree.map(to_master, params)
 
     # --- shared bf16-moments machinery (round 5): subclasses exposing a
     # ``moments_dtype`` field share the validation, dtype resolution,
@@ -91,6 +104,69 @@ class FusedOptimizer:
         out_p = tree_select(skip_if, params, new_params)
         out_s = tree_select(skip_if, state, new_state)
         return out_p, out_s
+
+    def apply_gradients(self, grads, state, params, *, skip_if=None,
+                        lr=None, grad_scale=None):
+        """Uniform, donation-friendly apply surface for step builders.
+
+        Every fused optimizer's ``step`` keeps its own signature quirks
+        (FusedLAMB grows a ``grad_scale`` kwarg and then returns a
+        3-tuple; the others don't take it). A donated fused train step
+        needs ONE entry point whose return is always ``(params, state)``
+        and whose output leaves are bit-compatible (same shape + dtype)
+        with the inputs — XLA only aliases a donated input buffer into
+        an output of identical layout, and silently falls back to a
+        copy otherwise. This method normalizes the signature, folds a
+        ``grad_scale`` unscale into the step when the optimizer supports
+        it natively (or pre-unscales when it doesn't), and raises at
+        trace time if an optimizer update would break buffer aliasing.
+        """
+        import inspect
+
+        if grad_scale is not None:
+            if "grad_scale" in inspect.signature(self.step).parameters:
+                out = self.step(grads, state, params, skip_if=skip_if,
+                                lr=lr, grad_scale=grad_scale)
+                new_params, new_state = out[0], out[1]
+            else:
+                inv = 1.0 / jnp.asarray(grad_scale, jnp.float32)
+                grads = jax.tree.map(
+                    lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                    grads)
+                from apex_tpu.utils.pytree import all_finite
+                found = jnp.logical_not(all_finite(grads))
+                skip_if = (found if skip_if is None
+                           else jnp.logical_or(skip_if, found))
+                new_params, new_state = self.step(grads, state, params,
+                                                  skip_if=skip_if, lr=lr)
+        else:
+            new_params, new_state = self.step(grads, state, params,
+                                              skip_if=skip_if, lr=lr)
+        self._check_alias_compatible(params, new_params, "params")
+        self._check_alias_compatible(state, new_state, "state")
+        return new_params, new_state
+
+    @staticmethod
+    def _check_alias_compatible(old, new, what: str):
+        """Raise if ``new``'s leaves can't alias ``old``'s donated
+        buffers (shape/dtype drift = XLA drops donation with only a
+        warning; tests need a hard signal)."""
+        old_l, new_l = jax.tree.leaves(old), jax.tree.leaves(new)
+        if len(old_l) != len(new_l):
+            raise ValueError(
+                f"optimizer step changed the {what} tree arity "
+                f"({len(old_l)} -> {len(new_l)} leaves); donated buffers "
+                f"cannot alias")
+        for a, b in zip(old_l, new_l):
+            a_shape, b_shape = jnp.shape(a), jnp.shape(b)
+            a_dt = jnp.asarray(a).dtype if not hasattr(a, "dtype") else a.dtype
+            b_dt = jnp.asarray(b).dtype if not hasattr(b, "dtype") else b.dtype
+            if a_shape != b_shape or a_dt != b_dt:
+                raise ValueError(
+                    f"optimizer step changed a {what} leaf from "
+                    f"{a_dt}{list(a_shape)} to {b_dt}{list(b_shape)}; a "
+                    f"donated buffer can only alias an identically-"
+                    f"shaped, identically-typed output")
 
     def as_optax(self):
         """Adapt to an ``optax.GradientTransformation``.

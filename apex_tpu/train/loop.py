@@ -1,0 +1,80 @@
+"""Deferred-metrics training loop driver.
+
+The serving engine's deferred sync (PR 3) restated for training: the
+host must never stand between two device dispatches. A loop that reads
+``loss`` right after ``step()`` serializes host and device — every step
+pays a full dispatch + fetch round trip. :class:`TrainLoop` instead
+keeps step ``t``'s metrics as unfetched device scalars, dispatches step
+``t+1``, and only THEN fetches ``t``'s values: the fetch overlaps the
+in-flight step, so the device queue never drains.
+
+Contract (docs/training.md): ``loop.step(batch)`` returns the metrics
+of the PREVIOUS step (``None`` on the first call); ``loop.drain()``
+returns the final pending metrics after the last step. Metrics arrive
+as host scalars (plain Python ``float``/``int``/``bool``), with any
+``aux`` pytree left as numpy arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(metrics) -> Dict[str, Any]:
+    """One host fetch of a metrics pytree, scalars unwrapped to Python."""
+    fetched = jax.device_get(metrics)
+
+    def unwrap(x):
+        arr = np.asarray(x)
+        return arr.item() if arr.ndim == 0 else arr
+
+    return jax.tree.map(unwrap, fetched)
+
+
+class TrainLoop:
+    """Drive a :class:`~apex_tpu.train.TrainStep` with deferred metric
+    fetches.
+
+    The loop OWNS the evolving :class:`TrainState`: with a donating step
+    the previous state's buffers are consumed by each dispatch, so
+    callers must not hold references to past states (see the donation
+    caveats in docs/training.md). Read ``loop.state`` only between
+    steps, and only the latest value.
+    """
+
+    def __init__(self, train_step, state):
+        self._train_step = train_step
+        self.state = state
+        self._pending = None  # last step's unfetched device metrics
+
+    def step(self, batch) -> Optional[Dict[str, Any]]:
+        """Dispatch one global step; return the PREVIOUS step's metrics
+        (fetched only now, while this step runs) — ``None`` on the
+        first call."""
+        self.state, metrics = self._train_step(self.state, batch)
+        prev, self._pending = self._pending, metrics
+        return None if prev is None else _to_host(prev)
+
+    def drain(self) -> Optional[Dict[str, Any]]:
+        """Fetch the final pending metrics (call after the last
+        :meth:`step`); ``None`` if nothing is pending. Also the
+        loop-end synchronization barrier: once it returns, every
+        dispatched step has executed."""
+        prev, self._pending = self._pending, None
+        return None if prev is None else _to_host(prev)
+
+    def run(self, batches: Iterable) -> List[Dict[str, Any]]:
+        """Feed every batch, deferred throughout; returns all metrics in
+        step order (the last entry fetched by the closing drain)."""
+        out = []
+        for batch in batches:
+            m = self.step(batch)
+            if m is not None:
+                out.append(m)
+        m = self.drain()
+        if m is not None:
+            out.append(m)
+        return out

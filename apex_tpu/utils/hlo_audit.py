@@ -102,6 +102,63 @@ def lowered_collective_stats(jitted, *args, **kwargs):
         jitted.lower(*args, **kwargs).compile().as_text())
 
 
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{(?P<out>[\d,\s]*)\}:\s*\((?P<param>\d+),\s*\{(?P<pidx>[\d,\s]*)\},"
+    r"\s*(?P<kind>may-alias|must-alias)\)")
+
+
+def input_output_alias_stats(hlo_text: str) -> Dict:
+    """Donation audit: parse the ``input_output_alias`` table of compiled
+    HLO into ``{"pairs": N, "params": sorted-param-numbers, "kinds":
+    {...}, "entries": [...]}``.
+
+    XLA DROPS a requested donation silently (a one-line warning at
+    best) when an output's layout/shape/dtype doesn't match the donated
+    input — the program still runs, just with a transient second copy
+    of every parameter and optimizer moment. A fused train step whose
+    whole point is in-place aliased updates therefore needs a POSITIVE
+    signal from the compiled program, not the absence of an error: this
+    counter is that signal (``pairs >= expected`` in tests), the
+    aliasing analog of :func:`collective_stats`.
+    """
+    entries = []
+    marker = "input_output_alias={"
+    start = hlo_text.find(marker)
+    if start >= 0:
+        # scan to the matching close brace (entries contain nested {})
+        i = start + len(marker)
+        depth = 1
+        while i < len(hlo_text) and depth:
+            if hlo_text[i] == "{":
+                depth += 1
+            elif hlo_text[i] == "}":
+                depth -= 1
+            i += 1
+        section = hlo_text[start + len(marker):i - 1]
+        for m in _ALIAS_ENTRY_RE.finditer(section):
+            entries.append({
+                "output_index": m.group("out").strip(),
+                "param_number": int(m.group("param")),
+                "kind": m.group("kind"),
+            })
+    kinds: Dict[str, int] = {}
+    for e in entries:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    return {
+        "pairs": len(entries),
+        "params": sorted({e["param_number"] for e in entries}),
+        "kinds": kinds,
+        "entries": entries,
+    }
+
+
+def lowered_alias_stats(jitted, *args, **kwargs) -> Dict:
+    """Compile ``jitted`` for ``args`` and return
+    :func:`input_output_alias_stats` of the optimized HLO."""
+    return input_output_alias_stats(
+        jitted.lower(*args, **kwargs).compile().as_text())
+
+
 def format_stats(stats: Dict[str, Dict[str, int]]) -> str:
     """One-line human summary of non-zero kinds (dryrun log format)."""
     parts = [f"{k}:{v['ops']}op/{v['bytes']}B"

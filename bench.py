@@ -39,13 +39,48 @@ import numpy as np
 # loss varies in the third decimal run-to-run, which is expected.
 _SALT = int(time.time() * 1e3) % (2 ** 30)
 
+# Resolved-once backend cache: _backend_with_cpu_fallback() resolves the
+# backend (with the CPU fallback) exactly once and every section reuses
+# the answer. BENCH_r01/r05 lost whole rounds (rc=1, parsed: null)
+# because sections re-called jax.default_backend() directly — a plugin
+# that came up after main()'s probe, then failed mid-run, resurfaced as
+# an uncaught init exception in the middle of the perf sweep.
+_RESOLVED_BACKEND = None
+
+
+def _backend_with_cpu_fallback():
+    """First touch of the JAX backend, with a CPU fallback: plugin init
+    can raise at first use (BENCH_r05: the TPU plugin came up
+    ``UNAVAILABLE`` and the whole run died with rc=1, recording
+    nothing). A crashed round is strictly worse than a CPU-smoke round
+    — fall back to ``JAX_PLATFORMS=cpu`` so the bench trajectory keeps
+    recording (the off-TPU metric names already mark smoke runs).
+    Memoized: later sections MUST use this (never
+    ``jax.default_backend()`` directly) so a mid-run plugin failure
+    can't resurface after the first resolution."""
+    global _RESOLVED_BACKEND
+    if _RESOLVED_BACKEND is not None:
+        return _RESOLVED_BACKEND
+    try:
+        _RESOLVED_BACKEND = jax.default_backend()
+    except Exception as e:
+        print(f"# backend init failed ({type(e).__name__}: {e}); "
+              "falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        _RESOLVED_BACKEND = jax.default_backend()
+    return _RESOLVED_BACKEND
+
 
 def build_step(cfg_kwargs, opt_level, batch, seq):
     import apex_tpu.amp as amp
     from apex_tpu.models import BertConfig, BertForPreTraining, pretraining_loss
     from apex_tpu.optimizers import FusedLAMB
 
-    maker = (BertConfig.bert_large if jax.default_backend() == "tpu"
+    maker = (BertConfig.bert_large if _backend_with_cpu_fallback() == "tpu"
              else BertConfig.tiny)  # off-TPU smoke: shape-check the flow
     # class-default dropouts (0.1/0.1): the real pretraining config
     cfg = maker(**cfg_kwargs)
@@ -311,7 +346,8 @@ def _measure(batch, seq, iters, with_baseline=True, remat=True):
         f"# B={batch} S={seq}: optimized(bf16 O2+fused) "
         f"{dt_opt*1e3:.1f} ms/step = {batch/dt_opt:.1f} samples/s "
         f"MFU={mfu:.3f} (loss {loss_opt:.3f}){base_txt} | "
-        f"params={info['n_params']/1e6:.0f}M backend={jax.default_backend()}",
+        f"params={info['n_params']/1e6:.0f}M "
+        f"backend={_backend_with_cpu_fallback()}",
         file=sys.stderr,
     )
     return dt_opt, dt_base, mfu
@@ -376,7 +412,7 @@ def _ab_chain_time(step_a, step_b, state, iters, rounds=3):
     return min(t_a), min(t_b)
 
 
-def bench_layer_norm():
+def bench_layer_norm(fast=False):
     """BASELINE configs[1]: FusedLayerNorm (training dispatch: XLA-fused
     fwd + Pallas bwd) vs stock-XLA LN, fwd+bwd at the shape the
     dispatcher serves — LN between GEMMs (the pre-LN transformer-block
@@ -404,7 +440,7 @@ def bench_layer_norm():
     # shape is ~1.6 TFLOP per timed call at the real size, far beyond a
     # CI core's budget (the round-4 bare-LN chain was bandwidth-light;
     # this one is deliberately matmul-bound — see docstring)
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
     N, H = (16 * 512, 1024) if on_tpu else (128, 64)
     n_apps = 16 if on_tpu else 2
     ks = jax.random.split(jax.random.PRNGKey(_SALT), 4)
@@ -443,7 +479,8 @@ def bench_layer_norm():
 
     state = (x0, w0, b0, W1, W2)
     dt_fused, dt_stock = _ab_chain_time(
-        mk(fused_layer_norm_affine), mk(stock_ln), state, iters=8)
+        mk(fused_layer_norm_affine), mk(stock_ln), state,
+        iters=4 if fast else 8, rounds=1 if fast else 3)
     return {
         "metric": "fused_layer_norm_fwdbwd_speedup_vs_xla",
         "value": round(dt_stock / dt_fused, 3),
@@ -452,21 +489,24 @@ def bench_layer_norm():
     }
 
 
-def bench_fused_lamb():
+def bench_fused_lamb(fast=False):
     """BASELINE configs[2]: FusedLAMB (multi_tensor flat-fusion step)
     vs a per-leaf unfused update chain, on a ResNet-50-class param set
-    (~25.6M params, 161 leaves). Value = speedup (x)."""
+    (~25.6M params, 161 leaves; ``fast=True`` shrinks the set for the
+    tier-1 smoke). Value = speedup (x)."""
     from apex_tpu.optimizers import FusedLAMB
 
     rng = np.random.RandomState(_SALT)
+    n_conv, n_bn = (5, 10) if fast else (53, 106)
     leaves = {}
     # ResNet-50-ish spectrum: many small conv/bn leaves + a few big ones
-    for i in range(53):
+    for i in range(n_conv):
         leaves[f"conv{i}"] = jnp.asarray(
             rng.randn(*(3, 3, 128, 256 if i % 3 else 512)).astype("f4") * .01)
-    for i in range(106):
+    for i in range(n_bn):
         leaves[f"bn{i}"] = jnp.asarray(rng.randn(512).astype("f4"))
-    leaves["fc"] = jnp.asarray(rng.randn(2048, 1000).astype("f4") * .01)
+    leaves["fc"] = jnp.asarray(
+        rng.randn(128 if fast else 2048, 1000).astype("f4") * .01)
     grads = jax.tree.map(lambda p: p * 0.01, leaves)
     n = sum(l.size for l in jax.tree.leaves(leaves))
 
@@ -514,10 +554,12 @@ def bench_fused_lamb():
         return params, m, v, step
 
     ost0 = opt.init(leaves)
-    dt_fused = _chain_time(fused_step, (leaves, ost0), iters=20)
+    iters = 4 if fast else 20
+    dt_fused = _chain_time(fused_step, (leaves, ost0), iters=iters)
     zeros = jax.tree.map(jnp.zeros_like, leaves)
     dt_eager = _chain_time(eager_step,
-                           (leaves, zeros, zeros, jnp.int32(0)), iters=20)
+                           (leaves, zeros, zeros, jnp.int32(0)),
+                           iters=iters)
     return {
         "metric": "fused_lamb_step_speedup_vs_per_leaf_eager",
         "value": round(dt_eager / dt_fused, 3),
@@ -548,8 +590,10 @@ from jax.sharding import PartitionSpec as P
 
 dp = int(sys.argv[1])
 sync = sys.argv[2] == "sync"  # nosync: same step minus the grad allreduce
+sys.path.insert(0, sys.argv[3])
 import apex_tpu  # noqa: F401
 from apex_tpu.parallel import DistributedDataParallel, SyncBatchNorm
+from apex_tpu.utils.collectives import compat_shard_map
 import flax.linen as nn
 
 class Net(nn.Module):
@@ -586,14 +630,13 @@ def train_step(variables, x, y):
     p2 = jax.tree.map(lambda p, gg: p - 1e-3 * gg, variables["params"], g)
     return {"params": p2, "batch_stats": mut["batch_stats"]}
 
-variables = jax.jit(jax.shard_map(
+variables = jax.jit(compat_shard_map(
     init_fn, mesh=mesh, in_specs=P("data"), out_specs=P()))(xb)
-step = jax.jit(jax.shard_map(
+step = jax.jit(compat_shard_map(
     train_step, mesh=mesh, in_specs=(P(), P("data"), P("data")),
     out_specs=P()))
 hlo = step.lower(variables, xb, yb).compile().as_text()
 grad_bytes = sum(l.size * 4 for l in jax.tree.leaves(variables["params"]))
-sys.path.insert(0, sys.argv[3])
 from apex_tpu.utils.hlo_audit import collective_stats
 st = collective_stats(hlo)
 other = {k: v for k, v in st.items()
@@ -757,27 +800,7 @@ def bench_long_context(seq=4096):
     }
 
 
-def _backend_with_cpu_fallback():
-    """First touch of the JAX backend, with a CPU fallback: plugin init
-    can raise at first use (BENCH_r05: the TPU plugin came up
-    ``UNAVAILABLE`` and the whole run died with rc=1, recording
-    nothing). A crashed round is strictly worse than a CPU-smoke round
-    — fall back to ``JAX_PLATFORMS=cpu`` so the bench trajectory keeps
-    recording (the off-TPU metric names already mark smoke runs)."""
-    try:
-        return jax.default_backend()
-    except Exception as e:
-        print(f"# backend init failed ({type(e).__name__}: {e}); "
-              "falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-        return jax.default_backend()
-
-
-def bench_serving():
+def bench_serving(fast=False):
     """Serving section (round 6): the continuous-batching engine
     (apex_tpu.serving) driving GPT decode with the paged KV-cache —
     prefill tokens/s, decode steps/s (one step = one token for every
@@ -785,12 +808,13 @@ def bench_serving():
     numbers don't contaminate each other: a max_new_tokens=1 drain is
     ~pure prefill; a drain with every slot busy is decode-dominated.
     On TPU this runs a GPT-2-small-class config; off-TPU the tiny smoke
-    config (flow check, metric named accordingly)."""
+    config (flow check, metric named accordingly). ``fast=True`` is the
+    tier-1 smoke shape (smallest workload, same code paths)."""
     from apex_tpu.models import GPTConfig, GPTLMHeadModel
     from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
                                   SamplingParams)
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
     if on_tpu:
         cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
                                    dtype=jnp.bfloat16)
@@ -802,7 +826,7 @@ def bench_serving():
         cfg = GPTConfig.tiny(dropout=0.0, remat=False)
         ecfg = EngineConfig(max_batch=4, block_size=8, num_blocks=64,
                             max_prefill_len=16, max_seq_len=48)
-        n_req, max_new, prompt_len = 6, 8, 12
+        n_req, max_new, prompt_len = (3, 4, 12) if fast else (6, 8, 12)
     model = GPTLMHeadModel(cfg)
     rng = np.random.RandomState(_SALT)
     params = model.init(
@@ -953,7 +977,7 @@ def bench_serving_multistep(fast=False):
     from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
                                   SamplingParams)
 
-    on_tpu = jax.default_backend() == "tpu" and not fast
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
     if on_tpu:
         cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
                                    dtype=jnp.bfloat16)
@@ -1034,8 +1058,197 @@ def bench_serving_multistep(fast=False):
     }
 
 
+def bench_train_step(fast=False):
+    """Fused train step (apex_tpu.train): the whole global optimizer
+    step — amp O2 scaled forward/backward, ``accum_steps`` scanned
+    microbatches with fp32 on-device accumulation, in-graph overflow
+    skip, fused-LAMB update — as ONE donated-buffer dispatch, swept
+    over ``accum_steps`` in {1, 4, 8} against the hand-wired
+    per-microbatch dispatch loop (``build_reference_loop``: one
+    dispatch per microbatch + an apply dispatch, the pre-builder
+    wiring). Reports steps/sec per arm, ASSERTS bit-identical final
+    params (fused vs loop, every arm — the training analog of the
+    serving bench's cross-K certification), and audits the compiled
+    program's input-output aliasing so a silently-dropped donation
+    reads as a regression, not a warning. ``vs_baseline`` is the
+    loop/fused time ratio at the largest accum: the dispatch
+    amortization itself. ``fast=True`` is the tier-1 smoke shape."""
+    import flax.linen as nn
+
+    import apex_tpu.amp as amp
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.train import build_reference_loop, build_train_step
+
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
+    if on_tpu:
+        hidden, depth, feat, classes, mb = 2048, 4, 512, 1024, 64
+        accums = (1, 4, 8)
+        ident_steps, iters = 8, 8
+    else:
+        hidden, depth, feat, classes, mb = 256, 2, 64, 16, 32
+        accums = (1, 4) if fast else (1, 4, 8)
+        ident_steps, iters = (4, 4) if fast else (8, 8)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(depth):
+                x = nn.Dense(hidden, param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+            return nn.Dense(classes, param_dtype=jnp.float32)(x)
+
+    model = Net()
+    rng = np.random.RandomState(_SALT + 2)
+    max_acc = max(accums)
+    xs_all = jnp.asarray(rng.randn(max_acc, mb, feat).astype("f4"))
+    ys_all = jnp.asarray(rng.randint(0, classes, (max_acc, mb)))
+
+    p0 = model.init(jax.random.PRNGKey(0), xs_all[0])["params"]
+    p0, opt, handle = amp.initialize(
+        p0, FusedLAMB(lr=1e-3, weight_decay=0.01), opt_level="O2",
+        verbosity=0)
+    n_param_leaves = len(jax.tree.leaves(p0))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply({"params": p}, x).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, y[:, None], 1))
+
+    def fresh(builder):
+        return builder.init(jax.tree.map(jnp.copy, p0))
+
+    def ab_time(stepper_a, state_a, stepper_b, state_b, batch,
+                rounds=3):
+        """Interleaved A/B marginal timing (the _ab_chain_time
+        methodology restated for (state, batch) steppers whose two arms
+        carry different state types): alternate arms round-robin so
+        both ride the same load drift, keep the min marginal per arm.
+        Each arm's state threads across rounds (donating steps consume
+        it; a replayed bit-identical sequence would also hit the
+        runtime memoizer)."""
+        arms = [[stepper_a, state_a, None], [stepper_b, state_b, None]]
+        mins = [None, None]
+        for arm in arms:                 # compile outside the clock
+            arm[1], m = arm[0](arm[1], batch)
+            arm[2] = m["loss"]
+            float(np.asarray(arm[2]))
+        for _ in range(rounds):
+            for i, arm in enumerate(arms):
+                def advance(n, arm=arm):
+                    for _ in range(n):
+                        arm[1], m = arm[0](arm[1], batch)
+                        arm[2] = m["loss"]
+
+                dt = marginal_time(
+                    advance, lambda arm=arm: float(np.asarray(arm[2])),
+                    iters)
+                mins[i] = dt if mins[i] is None else min(mins[i], dt)
+        return mins
+
+    # Donation probe (round-4 verify note: axon accepts a trivial donated
+    # jit but real-step donation can still die at run time) — fall back
+    # to donate=False so the sweep records rather than vanishing, with
+    # the fallback visible in the record.
+    donate = True
+    probe = build_train_step(loss_fn, opt, amp=handle, accum_steps=1)
+    try:
+        probe.step(fresh(probe), (xs_all[:1], ys_all[:1]))
+    except Exception as e:
+        donate = False
+        print(f"# train step: donated dispatch failed at run time "
+              f"({type(e).__name__}); falling back to donate=False",
+              file=sys.stderr)
+
+    sweep, all_identical, alias_pairs = {}, True, None
+    for a in accums:
+        batch = (xs_all[:a], ys_all[:a])
+        ts = build_train_step(loss_fn, opt, amp=handle, accum_steps=a,
+                              donate=donate)
+        ref = build_reference_loop(loss_fn, opt, amp=handle,
+                                   accum_steps=a)
+        if a == max_acc:                # donation audit on the big arm
+            alias_pairs = ts.alias_stats(fresh(ts), batch)["pairs"]
+        # bit-identity certification: same init, same stream, T steps
+        sA, sB = fresh(ts), fresh(ref)
+        for _ in range(ident_steps):
+            sA, _m = ts.step(sA, batch)
+            sB, _m = ref.step(sB, batch)
+        ident = all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves((sA.params, sA.opt_state)),
+                            jax.tree.leaves((sB.params, sB.opt_state))))
+        all_identical = all_identical and ident
+        dt_fused, dt_loop = ab_time(ts.step, fresh(ts), ref.step,
+                                    fresh(ref), batch,
+                                    rounds=1 if fast else 3)
+        sweep[f"accum{a}"] = {
+            "fused_steps_per_sec": round(1.0 / dt_fused, 3),
+            "loop_steps_per_sec": round(1.0 / dt_loop, 3),
+            "speedup": round(dt_loop / dt_fused, 3),
+            "bit_identical": bool(ident),
+        }
+
+    if not all_identical:
+        # the certification is the point: a fused-vs-loop bit mismatch
+        # must fail the section loudly (missing record in the round),
+        # never record rc=0 with a quietly-false JSON field
+        raise AssertionError(
+            "fused-scan vs per-microbatch loop params NOT bit-identical: "
+            + json.dumps({k: v["bit_identical"] for k, v in sweep.items()}))
+    top = sweep[f"accum{max_acc}"]
+    print("# train step: " + " | ".join(
+        f"accum={a} fused {sweep[f'accum{a}']['fused_steps_per_sec']:.1f} "
+        f"vs loop {sweep[f'accum{a}']['loop_steps_per_sec']:.1f} steps/s "
+        f"({sweep[f'accum{a}']['speedup']:.2f}x)" for a in accums)
+        + f" | bit-identical {all_identical} | donated alias pairs "
+        f"{alias_pairs}/{n_param_leaves} param leaves", file=sys.stderr)
+    return {
+        "metric": ("train_step_fused_accum8_steps_per_sec" if on_tpu
+                   else "train_step_tiny_smoke_fused_steps_per_sec"),
+        "value": top["fused_steps_per_sec"],
+        "unit": "steps/sec",
+        # the fused-vs-per-microbatch-dispatch amortization at max accum
+        "vs_baseline": top["speedup"],
+        "accum_steps_swept": list(accums),
+        "final_params_bit_identical": bool(all_identical),
+        "donated": bool(donate),
+        "donated_alias_pairs": int(alias_pairs),
+        "param_leaves": int(n_param_leaves),
+        "sweep": sweep,
+    }
+
+
 def main():
     on_tpu = _backend_with_cpu_fallback() == "tpu"
+    if "--smoke" in sys.argv:
+        # tier-1 guard mode (tests/test_train_step.py): every section in
+        # its fastest shape, one JSON line each, rc != 0 if ANY section
+        # dies — so a change that would blank a future bench round
+        # (BENCH_r01/r05: rc=1, parsed: null) fails CI instead of
+        # surfacing months later in a lost perf round.
+        failed = []
+        for name, fn in (
+            ("bench_layer_norm", lambda: bench_layer_norm(fast=True)),
+            ("bench_fused_lamb", lambda: bench_fused_lamb(fast=True)),
+            ("bench_ddp_scaling", bench_ddp_scaling),
+            ("bench_serving", lambda: bench_serving(fast=True)),
+            ("bench_serving_multistep",
+             lambda: bench_serving_multistep(fast=True)),
+            ("bench_train_step", lambda: bench_train_step(fast=True)),
+        ):
+            try:
+                print(json.dumps(fn()))
+            except Exception as e:
+                failed.append(name)
+                print(f"# --smoke section {name} FAILED: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            _reset()
+        if failed:
+            print(f"# --smoke: {len(failed)} section(s) failed: "
+                  f"{failed}", file=sys.stderr)
+            sys.exit(1)
+        return
     # Headline: the BASELINE seq-512-class pretraining shape. With the
     # logsumexp MLM loss, B=16 WITHOUT per-layer remat fits the 16 GB
     # chip and beats every remat'd batch (no recompute tax). Round-4
@@ -1079,7 +1292,7 @@ def main():
     # long-context attention record (S=4096 on TPU by default; add
     # S=2048 with --long-context)
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
-                 bench_serving, bench_serving_multistep]
+                 bench_serving, bench_serving_multistep, bench_train_step]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)
