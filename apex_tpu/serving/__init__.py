@@ -12,8 +12,10 @@ hook is ``GPTLMHeadModel.apply(..., kv_cache=...)``.
 
 from apex_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
+    EngineStalledError,
     InferenceEngine,
     Request,
+    RequestResult,
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator,

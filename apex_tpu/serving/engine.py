@@ -85,17 +85,38 @@ Scheduling (host-side, between jitted dispatches), per ``step()``:
 Finished requests *release references* instead of freeing: with prefix
 caching on, their full blocks stay indexed and evictable (LRU) until
 the pool actually needs the space.
+
+**Robustness** (docs/robustness.md): every jitted dispatch runs under a
+fault-gated, bounded-backoff retry (``max_dispatch_retries``); a
+request whose dispatch keeps failing is *quarantined* — failed with
+terminal status instead of killing the engine. Requests carry optional
+wall-clock deadlines (``Request.deadline_s``) and expire gracefully
+with status ``"timeout"`` and the tokens they emitted.
+``snapshot()``/``restore()`` round-trip the complete host-side picture
+through JSON: a restored engine re-prefills its live requests (cheap
+under prefix caching) and — because sampling is schedule-invariant —
+continues bit-identically to the uninterrupted run. ``run()`` raises a
+diagnostic :class:`EngineStalledError` instead of spinning if a full
+step ever makes no progress while work remains.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from apex_tpu.utils.faults import (
+    TRANSIENT_ERRORS,
+    DispatchFailedError,
+    SimulatedCrash,
+    guarded_call,
+)
 
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
@@ -118,13 +139,48 @@ from apex_tpu.serving.sampling import (
 class Request:
     """One generation request. ``prompt`` is a token-id sequence;
     generation runs until EOS (if ``eos_token_id`` is set) or
-    ``max_new_tokens``, whichever comes first."""
+    ``max_new_tokens``, whichever comes first — or until the request
+    leaves the engine early: past its ``deadline_s`` TTL (status
+    ``"timeout"``) or quarantined after repeated dispatch failures
+    (status ``"failed"``). Early exits are graceful: tokens already
+    emitted are returned."""
 
     uid: str
     prompt: Sequence[int]
     max_new_tokens: int = 16
     sampling: SamplingParams = SamplingParams()
     eos_token_id: Optional[int] = None
+    # Wall-clock TTL in seconds from add_request, measured against the
+    # engine's clock (injectable for tests). None = no deadline.
+    deadline_s: Optional[float] = None
+    # Terminal lifecycle status — "finished" | "timeout" | "failed" —
+    # written by the engine via object.__setattr__ when the request
+    # leaves it (the one engine-owned field of the frozen request);
+    # None while waiting/active. Excluded from equality/hash.
+    status: Optional[str] = dataclasses.field(default=None, compare=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestResult:
+    """One entry of ``run(return_status=True)``: the generated tokens
+    plus the request's terminal status (the result contract in
+    docs/serving.md). ``tokens`` may be shorter than ``max_new_tokens``
+    for ``"timeout"``/``"failed"`` exits — everything emitted before
+    the cut is preserved."""
+
+    tokens: List[int]
+    status: str
+
+
+class EngineStalledError(RuntimeError):
+    """``has_work`` is true but a full ``step()`` made no progress —
+    no admission, prefill chunk, decode dispatch, drain, expiry,
+    preemption, or quarantine. The scheduler would spin forever;
+    ``engine_stats`` carries ``stats()`` at the stall for diagnosis."""
+
+    def __init__(self, message: str, stats: Dict[str, float]):
+        super().__init__(f"{message} (stats: {stats})")
+        self.engine_stats = stats
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,6 +218,13 @@ class EngineConfig:
     # bench.py's --donate probe history) and older CPU jaxlibs ignore
     # donation with a warning; flip on for runtimes that support it.
     donate_cache: bool = False
+    # Robustness knobs (docs/robustness.md): a failed prefill/decode
+    # dispatch is retried up to max_dispatch_retries times with
+    # exponential backoff (retry_backoff_s * 2**attempt seconds between
+    # attempts; 0 = immediate, the test default) before the offending
+    # request is quarantined with terminal status "failed".
+    max_dispatch_retries: int = 2
+    retry_backoff_s: float = 0.0
     seed: int = 0
 
 
@@ -224,11 +287,29 @@ class InferenceEngine:
     the continuous-batching point.
     """
 
-    def __init__(self, model, params, config: EngineConfig):
+    def __init__(self, model, params, config: EngineConfig, *,
+                 faults=None, clock=None):
         cfg = model.cfg
         self.model = model
         self.params = params
         self.config = config
+        # optional chaos harness (apex_tpu.utils.faults.FaultPlan): every
+        # jitted dispatch fires the plan at its site ("prefill"/"decode")
+        # before launching, so chaos tests are seeded and reproducible
+        self.faults = faults
+        if faults is not None:
+            # the engine's outputs are integer tokens, so there is no
+            # float output the "nan" kind could meaningfully corrupt —
+            # reject rather than record a fire that changed nothing
+            bad = [s.site for s in getattr(faults, "specs", ())
+                   if s.kind == "nan" and s.site in ("prefill", "decode")]
+            if bad:
+                raise ValueError(
+                    f"nan faults are not supported at serving sites "
+                    f"{sorted(set(bad))}; use transient/crash (the "
+                    f"train loop's watchdog owns nan handling)")
+        # deadline clock, injectable so TTL tests are deterministic
+        self._clock = time.monotonic if clock is None else clock
         self._chunk = (config.prefill_chunk if config.prefill_chunk
                        is not None else config.max_prefill_len)
         if self._chunk < 1:
@@ -251,6 +332,10 @@ class InferenceEngine:
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
         self.waiting: deque = deque()
         self.finished: Dict[str, List[int]] = {}
+        # terminal status per finished uid ("finished"|"timeout"|"failed");
+        # drained alongside `finished` by run()
+        self.statuses: Dict[str, str] = {}
+        self._deadline: Dict[str, float] = {}   # uid -> absolute deadline
         self._key = jax.random.PRNGKey(config.seed)
         self._arrival_count = 0
         self._admit_count = 0
@@ -263,6 +348,12 @@ class InferenceEngine:
         self._prefix_hit_blocks = 0
         self._prefix_lookup_blocks = 0
         self._prompt_blocks_allocated = 0
+        self._num_timeouts = 0
+        self._num_dispatch_retries = 0
+        self._num_quarantines = 0
+        self._num_snapshots = 0
+        self._num_restores = 0
+        self._fetch_failures = 0   # consecutive failed deferred drains
         # the in-flight decode dispatch: (device [B, K] tokens, device
         # [B] counts, the lane indices it covers). Fetched — the only
         # host sync of the decode path — at the NEXT tick, after that
@@ -363,7 +454,16 @@ class InferenceEngine:
                 f"request {request.uid!r}: prompt + max_new_tokens "
                 f"({n} + {request.max_new_tokens}) exceeds max_seq_len "
                 f"({self.config.max_seq_len})")
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            raise ValueError(
+                f"request {request.uid!r}: deadline_s must be positive "
+                f"(got {request.deadline_s})")
         request.sampling.validate()
+        # the engine owns the terminal-status field from here on (a
+        # re-submitted request object starts a fresh lifecycle)
+        object.__setattr__(request, "status", None)
+        if request.deadline_s is not None:
+            self._deadline[request.uid] = self._clock() + request.deadline_s
         self.waiting.append(_QueueEntry(request=request,
                                         arrival=self._arrival_count))
         self._arrival_count += 1
@@ -436,18 +536,124 @@ class InferenceEngine:
             jnp.asarray(arrivals))
         return temp, top_k, top_p, jnp.asarray(eos), keys
 
-    def _finish(self, idx: int) -> None:
+    def _set_status(self, request: Request, status: str) -> None:
+        """Record a terminal status: in the drain-able ``statuses`` map,
+        on the request object itself, and out of the deadline watch."""
+        self.statuses[request.uid] = status
+        object.__setattr__(request, "status", status)
+        self._deadline.pop(request.uid, None)
+
+    @staticmethod
+    def _resume_tokens(slot: "_Slot") -> List[int]:
+        """The tokens a slot's request carries out of residency — into
+        ``finished``, a requeue entry, or a snapshot record. A started
+        slot owns its live ``generated`` list; one still mid-prefill
+        never resampled, so its history is the queue entry's."""
+        return (list(slot.generated) if slot.started
+                else list(slot.entry.generated))
+
+    def _finish(self, idx: int, status: str = "finished") -> None:
         """Release the slot: refs drop, and with prefix caching on the
         registered blocks stay cached (evictable) rather than freed.
         Released DEEPEST-first: eviction pops the oldest insertion, and
         evicting a chain's head block orphans every descendant (the
         lookup misses at hash 0), so the tail must age out before the
-        head for partial chains to stay matchable."""
+        head for partial chains to stay matchable. ``status`` is the
+        terminal outcome ("finished", or "timeout" for a deadline
+        expiry mid-generation — the tokens emitted so far are kept)."""
         slot = self.slots[idx]
         self.allocator.free(list(reversed(slot.blocks)))
-        self.finished[slot.request.uid] = slot.generated
+        self.finished[slot.request.uid] = self._resume_tokens(slot)
+        self._set_status(slot.request, status)
         self.slots[idx] = None
         self._invalidate_lanes()
+
+    def _quarantine_slot(self, idx: int) -> None:
+        """Terminal-fail one lane's request after its dispatches
+        exhausted every retry: same release path as a normal finish,
+        status ``"failed"``, tokens already emitted kept. The engine —
+        and every other lane — keeps serving."""
+        self._finish(idx, status="failed")
+        self._num_quarantines += 1
+
+    def _expire_deadlines(self, include_started: bool) -> int:
+        """Finish every request past its deadline with status
+        ``"timeout"`` — gracefully: tokens already emitted ride into
+        ``finished``. Waiting entries and mid-prefill (unstarted)
+        slots expire any time — an in-flight decode only covers
+        STARTED lanes; started slots only when no decode dispatch is
+        in flight over them (``include_started`` — callers pass True
+        only after the drain), because finishing a lane the pending
+        fetch still covers would corrupt the drain bookkeeping."""
+        if not self._deadline:
+            return 0
+        now = self._clock()
+        expired = 0
+        if self.waiting:
+            kept: deque = deque()
+            while self.waiting:
+                entry = self.waiting.popleft()
+                dl = self._deadline.get(entry.request.uid)
+                if dl is not None and now >= dl:
+                    self.finished[entry.request.uid] = list(entry.generated)
+                    self._set_status(entry.request, "timeout")
+                    self._num_timeouts += 1
+                    expired += 1
+                else:
+                    kept.append(entry)
+            self.waiting = kept
+        for i, slot in enumerate(self.slots):
+            if slot is None or (slot.started and not include_started):
+                continue
+            dl = self._deadline.get(slot.request.uid)
+            if dl is not None and now >= dl:
+                self._finish(i, status="timeout")
+                self._num_timeouts += 1
+                expired += 1
+        return expired
+
+    def _reset_device_state(self) -> None:
+        """The in-process analog of a crash restore: requeue every
+        resident request (preemption-style, carrying its emitted
+        tokens, oldest at the head), wipe the allocator — refcounts,
+        prefix index, LRU set — and zero the pool. Everything
+        device-resident re-derives from host state through re-prefill,
+        bit-identically (the resume-determinism contract). Used when a
+        failed decode drain may have poisoned the pool; also the
+        reason fetch-failure recovery needs no rollback copy."""
+        live = sorted(((s.admit_seq, i)
+                       for i, s in enumerate(self.slots)
+                       if s is not None), reverse=True)
+        for _, i in live:    # youngest first, so the oldest lands at head
+            slot = self.slots[i]
+            self.waiting.appendleft(_QueueEntry(
+                request=slot.request, arrival=slot.entry.arrival,
+                generated=self._resume_tokens(slot)))
+            self.slots[i] = None
+        self.allocator.reset()
+        self.cache = jax.tree.map(jnp.zeros_like, self.cache)
+        self._invalidate_lanes()
+
+    def _guarded_dispatch(self, site: str, fn, *args):
+        """One jitted dispatch (including its fetch, when the caller
+        folds it into ``fn``) under the shared retry policy
+        (:func:`apex_tpu.utils.faults.guarded_call`): transient
+        failures — injected, or the runtime's real dispatch errors —
+        retry ``max_dispatch_retries`` times with exponential backoff;
+        exhaustion raises :class:`DispatchFailedError` for the caller
+        to quarantine the offending request. Retry is sound because
+        ``donate_cache`` defaults off: a failed attempt's inputs are
+        intact (with donation the pool may be consumed; recover via
+        snapshot/restore instead)."""
+
+        def count(attempt):
+            self._num_dispatch_retries += 1
+
+        out, _ = guarded_call(
+            fn, *args, plan=self.faults, site=site,
+            retries=self.config.max_dispatch_retries,
+            backoff_s=self.config.retry_backoff_s, on_retry=count)
+        return out
 
     def _record_token(self, idx: int, token: int) -> None:
         """Append a sampled token to a slot, finishing on EOS/max-len."""
@@ -581,14 +787,40 @@ class InferenceEngine:
         table = np.full((1, self.max_blocks_per_seq), -1, np.int32)
         table[0, : len(slot.blocks)] = slot.blocks
         temp, top_k, top_p = self._sampling_arrays([slot.request.sampling])
-        self.cache, tok = self._prefill(
-            self.params, self.cache, jnp.asarray(ids),
-            jnp.asarray(positions),
-            jnp.asarray([end], jnp.int32),
-            jnp.asarray([slot.prefill_pos], jnp.int32),     # write_start
-            jnp.asarray([(L - 1) - start], jnp.int32),      # sample_idx
-            device_block_table(table, self.config.num_blocks),
-            self._request_key(slot.entry), temp, top_k, top_p)
+
+        def attempt():
+            # dispatch AND fetch inside the retry unit — EVERY chunk,
+            # deliberately paying one host sync per chunk: prefill's
+            # only device output is one token, and async dispatch
+            # surfaces real runtime failures at the fetch — `self.cache`
+            # is untouched until the whole attempt succeeds, so a retry
+            # reruns the identical program (no rollback needed; under
+            # donate_cache a failed attempt consumed the pool and the
+            # retry's deleted-buffer error propagates as non-transient).
+            # A launch-only guard on intermediate chunks would defer an
+            # async failure into a LATER dispatch that shares the (now
+            # poisoned) cache — decode over other lanes, or the next
+            # chunk — quarantining innocent requests or cascading into
+            # the drain-failure reset; the per-chunk sync is the price
+            # of exact fault isolation, amortized over C tokens of
+            # forward compute
+            cache, tok = self._prefill(
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(positions),
+                jnp.asarray([end], jnp.int32),
+                jnp.asarray([slot.prefill_pos], jnp.int32),   # write_start
+                jnp.asarray([(L - 1) - start], jnp.int32),    # sample_idx
+                device_block_table(table, self.config.num_blocks),
+                self._request_key(slot.entry), temp, top_k, top_p)
+            return cache, int(tok[0])
+
+        try:
+            self.cache, tok0 = self._guarded_dispatch("prefill", attempt)
+        except DispatchFailedError:
+            # the failing program saw exactly one request: quarantine it
+            # (terminal "failed", blocks released) and keep serving
+            self._quarantine_slot(idx)
+            return True
         self._num_prefill_chunks += 1
         slot.prefill_pos = end
         slot.context_len = max(slot.context_len, end)
@@ -603,7 +835,7 @@ class InferenceEngine:
                 slot.generated = list(slot.entry.generated)
                 slot.last_token = slot.generated[-1]
             else:
-                self._record_token(idx, int(tok[0]))
+                self._record_token(idx, tok0)
         return True
 
     # -- decode-time block growth, CoW, preemption -------------------------
@@ -621,8 +853,7 @@ class InferenceEngine:
             return False
         idx = max(cand)[1]
         slot = self.slots[idx]
-        gen = (list(slot.generated) if slot.started
-               else list(slot.entry.generated))
+        gen = self._resume_tokens(slot)
         # deepest-first, same as _finish: keep evictable chains matchable
         self.allocator.free(list(reversed(slot.blocks)))
         self.waiting.appendleft(_QueueEntry(request=slot.request,
@@ -706,28 +937,46 @@ class InferenceEngine:
         """Launch the K-step fused decode for ``active`` lanes and
         leave the result in flight (``self._pending``). Only the small
         per-tick arrays (tokens, context lens, budgets, counts) upload
-        here; the block table and lane meta come from their mirrors."""
+        here; the block table and lane meta come from their mirrors.
+
+        When the dispatch exhausts its retries, the batch is poisoned
+        but nothing says which lane: isolation is by elimination — the
+        YOUNGEST lane is quarantined (same yield order as preemption:
+        the oldest request keeps its progress priority) and the
+        dispatch is rebuilt over the survivors, until it launches or no
+        decoding lane remains. A persistent site-wide fault therefore
+        fails requests one at a time instead of killing the engine."""
         B = self.config.max_batch
-        tokens = np.zeros(B, np.int32)
-        ctx = np.zeros(B, np.int32)
-        budgets = np.zeros(B, np.int32)
-        gcounts = np.zeros(B, np.int32)
-        for i in active:
-            slot = self.slots[i]
-            tokens[i] = slot.last_token
-            ctx[i] = slot.context_len
-            budgets[i] = (slot.request.max_new_tokens
-                          - len(slot.generated))
-            gcounts[i] = len(slot.generated)
-        tables = self._dev_tables.get(self._build_decode_tables)
-        temp, top_k, top_p, eos, keys = self._dev_lanes.get(
-            self._build_lane_meta)
-        self.cache, toks = self._decode(
-            self.params, self.cache, jnp.asarray(tokens), tables,
-            jnp.asarray(ctx), jnp.asarray(budgets), jnp.asarray(gcounts),
-            eos, keys, temp, top_k, top_p)
-        self._num_decode_dispatches += 1
-        self._pending = (toks, list(active))
+        while active:
+            tokens = np.zeros(B, np.int32)
+            ctx = np.zeros(B, np.int32)
+            budgets = np.zeros(B, np.int32)
+            gcounts = np.zeros(B, np.int32)
+            for i in active:
+                slot = self.slots[i]
+                tokens[i] = slot.last_token
+                ctx[i] = slot.context_len
+                budgets[i] = (slot.request.max_new_tokens
+                              - len(slot.generated))
+                gcounts[i] = len(slot.generated)
+            tables = self._dev_tables.get(self._build_decode_tables)
+            temp, top_k, top_p, eos, keys = self._dev_lanes.get(
+                self._build_lane_meta)
+            try:
+                self.cache, toks = self._guarded_dispatch(
+                    "decode", self._decode,
+                    self.params, self.cache, jnp.asarray(tokens), tables,
+                    jnp.asarray(ctx), jnp.asarray(budgets),
+                    jnp.asarray(gcounts), eos, keys, temp, top_k, top_p)
+            except DispatchFailedError:
+                idx = max((self.slots[i].admit_seq, i) for i in active)[1]
+                self._quarantine_slot(idx)
+                active = [i for i, s in enumerate(self.slots)
+                          if s is not None and s.started]
+                continue
+            self._num_decode_dispatches += 1
+            self._pending = (toks, list(active))
+            return
 
     def _drain_decode(self) -> bool:
         """The deferred host sync: fetch the in-flight dispatch's
@@ -735,12 +984,51 @@ class InferenceEngine:
         and replay them through the per-token bookkeeping —
         cache-token append, block registration, EOS/budget finish. The
         device's stop mask mirrors ``_record_token`` exactly, so a lane
-        that froze mid-scan finishes here on the same token."""
+        that froze mid-scan finishes here on the same token.
+
+        Dispatch is asynchronous, so a REAL runtime failure surfaces
+        here, at the fetch, not at the launch `_guarded_dispatch`
+        guards — and a failed program poisons every output it produced,
+        including the new pool. Recovery is the in-process analog of a
+        crash restore (:meth:`_reset_device_state`): every resident
+        request re-queues carrying its emitted tokens, the allocator
+        and prefix index reset, the pool zeroes, and re-prefill
+        re-derives everything — bit-identical continuation by the same
+        resume determinism ``restore()`` leans on, and valid even under
+        ``donate_cache`` (nothing from the failed dispatch is reused).
+        Consecutive drain failures count against
+        ``max_dispatch_retries``; exhaustion quarantines the youngest
+        covered lane before the reset."""
         if self._pending is None:
             return False
         toks, active = self._pending
         self._pending = None
-        toks = np.asarray(toks)
+        try:
+            toks = np.asarray(toks)
+        except SimulatedCrash:
+            raise
+        except TRANSIENT_ERRORS:
+            self._fetch_failures += 1
+            if self._fetch_failures > self.config.max_dispatch_retries:
+                # exhausted — same attempt arithmetic as guarded_call
+                # (N retries = N+1 attempts, no sleep after the last),
+                # so serving/training retry counters stay comparable
+                live = [i for i in active
+                        if self.slots[i] is not None
+                        and self.slots[i].started]
+                if live:
+                    idx = max((self.slots[i].admit_seq, i)
+                              for i in live)[1]
+                    self._quarantine_slot(idx)
+                self._fetch_failures = 0
+            else:
+                self._num_dispatch_retries += 1
+                if self.config.retry_backoff_s > 0.0:
+                    time.sleep(self.config.retry_backoff_s
+                               * (2 ** (self._fetch_failures - 1)))
+            self._reset_device_state()
+            return True
+        self._fetch_failures = 0
         # each lane's emitted tokens are its non-sentinel prefix (lanes
         # freeze permanently mid-scan, and real token ids are >= 0)
         counts = (toks >= 0).sum(axis=1)
@@ -756,21 +1044,37 @@ class InferenceEngine:
             self._num_tokens_decoded += int(counts[i])
         return True
 
-    def step(self) -> None:
-        """One scheduler tick: admit, run at most one prefill chunk,
-        drain the previous tick's in-flight decode, then dispatch one
-        fused K-step decode for every started slot (if any). The drain
-        comes AFTER admission/prefill on purpose — tick t+1's host
-        scheduling work overlaps tick t's device decode (the deferred
-        sync) — with an admission top-up behind it so lanes freed by
-        the drain don't idle a tick."""
+    def step(self) -> bool:
+        """One scheduler tick: expire deadlines, admit, run at most one
+        prefill chunk, drain the previous tick's in-flight decode, then
+        dispatch one fused K-step decode for every started slot (if
+        any). The drain comes AFTER admission/prefill on purpose — tick
+        t+1's host scheduling work overlaps tick t's device decode (the
+        deferred sync) — with an admission top-up behind it so lanes
+        freed by the drain (or a timeout) don't idle a tick.
+
+        Returns True when the tick made progress — admitted, chunked,
+        drained, expired, dispatched, preempted, or quarantined
+        something. ``run()`` turns a no-progress tick with work
+        remaining into :class:`EngineStalledError` instead of spinning.
+        """
+        # waiting entries and mid-prefill slots are expirable up front
+        # (so an expired slot never gets one last wasted chunk);
+        # started slots only when no decode dispatch is in flight over
+        # them — otherwise the post-drain sweep picks them up
+        expired = self._expire_deadlines(
+            include_started=self._pending is None)
         admitted = self._admit()
         chunked = self._prefill_tick()
         synced = self._drain_decode()
-        if synced:
+        # the in-flight dispatch (if any) is drained now, so resident
+        # slots are safe to expire too
+        expired += self._expire_deadlines(include_started=True)
+        if synced or expired:
             admitted += self._admit()
+        made = bool(admitted or chunked or synced or expired)
         if all(s is None for s in self.slots):
-            if self.waiting and not admitted and not chunked and not synced:
+            if self.waiting and not made:
                 # zero live sequences and nothing in flight means
                 # nothing will ever free a block — the queue head can
                 # never be admitted (the pool is undersized for it).
@@ -782,18 +1086,21 @@ class InferenceEngine:
                     f"request {entry.request.uid!r} needs {need} blocks "
                     f"to admit but only {self.allocator.num_blocks} exist "
                     "in the pool")
-            return
+            return made
+        pre_preempt = self._num_preemptions
+        pre_quarantine = self._num_quarantines
         active = [i for i, s in enumerate(self.slots)
                   if s is not None and s.started]
-        if not active:
-            return
-        self._ensure_decode_blocks()
-        # preemption may have cleared lanes — re-collect
-        active = [i for i, s in enumerate(self.slots)
-                  if s is not None and s.started]
-        if not active:
-            return
-        self._dispatch_decode(active)
+        if active:
+            self._ensure_decode_blocks()
+            # preemption may have cleared lanes — re-collect
+            active = [i for i, s in enumerate(self.slots)
+                      if s is not None and s.started]
+        if active:
+            self._dispatch_decode(active)
+        return bool(made or self._pending is not None
+                    or self._num_preemptions > pre_preempt
+                    or self._num_quarantines > pre_quarantine)
 
     @property
     def has_work(self) -> bool:
@@ -806,13 +1113,173 @@ class InferenceEngine:
         return (bool(self.waiting) or self._pending is not None
                 or any(s is not None for s in self.slots))
 
-    def run(self) -> Dict[str, List[int]]:
+    def run(self, return_status: bool = False):
         """Drain: step until every queued, active, and in-flight
-        request finishes. Returns ``{uid: generated_token_ids}``."""
+        request reaches a terminal state. Returns ``{uid:
+        generated_token_ids}`` — or, with ``return_status=True``,
+        ``{uid: RequestResult(tokens, status)}`` where ``status`` is
+        ``"finished"`` | ``"timeout"`` | ``"failed"`` (the result
+        contract in docs/serving.md; the same status is written onto
+        each ``Request.status``). If a full step makes no progress
+        while work remains, raises :class:`EngineStalledError` with
+        ``stats()`` attached instead of spinning forever."""
         while self.has_work:
-            self.step()
+            if not self.step():
+                raise EngineStalledError(
+                    "engine has work but a full step made no progress",
+                    self.stats())
         out, self.finished = self.finished, {}
+        statuses, self.statuses = self.statuses, {}
+        if return_status:
+            return {uid: RequestResult(tokens=toks,
+                                       status=statuses.get(uid, "finished"))
+                    for uid, toks in out.items()}
         return out
+
+    # -- crash-consistent snapshot / restore (docs/robustness.md) ---------
+
+    def _config_fingerprint(self) -> Dict[str, object]:
+        """The engine config as JSON-able values; a snapshot only
+        restores into an engine built with the identical config (the
+        compiled-program shapes, pool geometry, and PRNG seed all hang
+        off it — any drift breaks the bit-identity contract). The
+        retry knobs are operational, not identity: an operator
+        recovering from an incident may legitimately restore into an
+        engine with a bigger retry budget or no backoff, and outputs
+        are unaffected, so they stay out of the fingerprint."""
+        d = dataclasses.asdict(self.config)
+        d["kv_dtype"] = (None if self.config.kv_dtype is None
+                         else str(jnp.dtype(self.config.kv_dtype)))
+        for knob in ("max_dispatch_retries", "retry_backoff_s"):
+            d.pop(knob, None)
+        return d
+
+    def _entry_record(self, entry: _QueueEntry, now: float) -> Dict:
+        req = entry.request
+        rec = {
+            "uid": req.uid,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": (None if req.eos_token_id is None
+                             else int(req.eos_token_id)),
+            "sampling": {"temperature": float(req.sampling.temperature),
+                         "top_k": int(req.sampling.top_k),
+                         "top_p": float(req.sampling.top_p)},
+            "arrival": int(entry.arrival),
+            "generated": [int(t) for t in entry.generated],
+        }
+        dl = self._deadline.get(req.uid)
+        if dl is not None:
+            # deadlines serialize as REMAINING budget: the restoring
+            # process re-anchors them on its own clock
+            rec["deadline_remaining_s"] = float(dl - now)
+        return rec
+
+    def snapshot(self) -> Dict[str, object]:
+        """Crash-consistent, JSON-serializable picture of the engine.
+
+        Drains the in-flight decode first (one host sync), so no
+        emitted token is ever lost to a snapshot boundary. Live slots
+        serialize as preempted-style resumable entries — prompt,
+        emitted tokens, arrival index (the PRNG identity) — in
+        admission order, ahead of the waiting queue; ``finished``,
+        terminal statuses, remaining deadline budgets, and the config
+        fingerprint ride along. The block tables and allocator state
+        (refcounts, prefix index, LRU order) are included as an AUDIT
+        section: :meth:`restore` deliberately does not reload them,
+        because KV block contents do not survive a process — the
+        restored engine re-prefills through the prefix cache and
+        rebuilds them (bit-identically, by resume determinism)."""
+        self._drain_decode()
+        now = self._clock()
+        live = sorted((s.admit_seq, i) for i, s in enumerate(self.slots)
+                      if s is not None)
+        requests = []
+        for _, i in live:
+            slot = self.slots[i]
+            requests.append(self._entry_record(
+                _QueueEntry(request=slot.request, arrival=slot.entry.arrival,
+                            generated=self._resume_tokens(slot)), now))
+        for entry in self.waiting:
+            requests.append(self._entry_record(entry, now))
+        self._num_snapshots += 1
+        return {
+            "version": 1,
+            "config": self._config_fingerprint(),
+            "arrival_count": int(self._arrival_count),
+            "requests": requests,
+            "finished": {uid: [int(t) for t in toks]
+                         for uid, toks in self.finished.items()},
+            "statuses": dict(self.statuses),
+            "counters": self.stats(),
+            "block_tables": {
+                self.slots[i].request.uid: [int(b) for b in
+                                            self.slots[i].blocks]
+                for _, i in live},
+            "allocator": self.allocator.snapshot_state(),
+        }
+
+    def restore(self, snap: Dict[str, object]) -> None:
+        """Load a :meth:`snapshot` into a FRESHLY constructed engine
+        (same model, params, and config — the fingerprint is checked,
+        the params are the caller's contract). Every unfinished request
+        re-enters the waiting queue in snapshot order carrying its
+        emitted tokens and original arrival index, so re-admission
+        re-prefills ``prompt + generated[:-1]`` (cheap when its blocks
+        are still/again cached) and the schedule-invariant sampler
+        continues the exact token stream: a restored ``run()`` is
+        bit-identical to the uninterrupted one (tested, including
+        across processes)."""
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
+        mine, theirs = self._config_fingerprint(), dict(snap["config"])
+        if mine != theirs:
+            diff = {k: (theirs.get(k), mine.get(k))
+                    for k in set(mine) | set(theirs)
+                    if mine.get(k) != theirs.get(k)}
+            raise ValueError(
+                f"snapshot config mismatch (snapshot vs engine): {diff}")
+        if self.has_work or self._arrival_count or self.finished:
+            raise RuntimeError(
+                "restore() requires a fresh engine: this one has queued, "
+                "resident, in-flight, or finished requests")
+        now = self._clock()
+        for rec in snap["requests"]:
+            deadline = rec.get("deadline_remaining_s")
+            req = Request(
+                uid=rec["uid"], prompt=list(rec["prompt"]),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                sampling=SamplingParams(
+                    temperature=rec["sampling"]["temperature"],
+                    top_k=rec["sampling"]["top_k"],
+                    top_p=rec["sampling"]["top_p"]),
+                eos_token_id=rec.get("eos_token_id"),
+                deadline_s=deadline)
+            if deadline is not None:
+                # an already-blown deadline stays blown (<= now)
+                self._deadline[req.uid] = now + deadline
+            self.waiting.append(_QueueEntry(
+                request=req, arrival=int(rec["arrival"]),
+                generated=[int(t) for t in rec["generated"]]))
+        self._arrival_count = int(snap["arrival_count"])
+        self.finished.update({uid: [int(t) for t in toks]
+                              for uid, toks in snap["finished"].items()})
+        self.statuses.update(snap["statuses"])
+        self._num_restores += 1
+
+    def check_allocator_integrity(self) -> None:
+        """Cross-check the allocator against the engine's own
+        bookkeeping: internal invariants plus an EXACT refcount match —
+        each block's count must equal the number of resident slots
+        referencing it (chaos tests call this after restore + LRU
+        churn)."""
+        expected: Dict[int, int] = {}
+        for slot in self.slots:
+            if slot is None:
+                continue
+            for b in slot.blocks:
+                expected[b] = expected.get(b, 0) + 1
+        self.allocator.check_integrity(expected_refcounts=expected)
 
     def stats(self) -> Dict[str, float]:
         alloc = self.allocator
@@ -846,4 +1313,11 @@ class InferenceEngine:
             "prefix_cache_hit_rate": (self._prefix_hit_blocks / lookups
                                       if lookups else 0.0),
             "prompt_blocks_allocated": self._prompt_blocks_allocated,
+            # robustness counters (docs/robustness.md): every failure
+            # path feeds one, so chaos runs are assertable from stats()
+            "num_timeouts": self._num_timeouts,
+            "num_dispatch_retries": self._num_dispatch_retries,
+            "num_quarantines": self._num_quarantines,
+            "num_snapshots": self._num_snapshots,
+            "num_restores": self._num_restores,
         }

@@ -294,6 +294,69 @@ class BlockAllocator:
         self._block_to_hash.clear()
         self._evictable.clear()
 
+    # -- robustness: audit + integrity (docs/robustness.md) ----------------
+
+    def snapshot_state(self) -> Dict[str, object]:
+        """JSON-serializable picture of the allocator: refcounts, the
+        prefix index, the evictable LRU order, and the free list. This
+        is the AUDIT section of an engine snapshot — block ids and the
+        KV contents behind them do not survive a process, so restore
+        rebuilds allocator state from re-prefills rather than loading
+        this (tests verify the rebuild reproduces the same hash chains
+        and refcount structure)."""
+        return {
+            "refcounts": {str(b): int(c) for b, c in self._ref.items()},
+            "prefix_index": dict(self._hash_to_block),
+            "evictable": [int(b) for b in self._evictable],
+            "free": [int(b) for b in self._free],
+            "num_evictions": int(self.num_evictions),
+        }
+
+    def check_integrity(self, expected_refcounts: Optional[Dict[int, int]]
+                        = None) -> None:
+        """Raise ``ValueError`` on any violated allocator invariant:
+        every block in exactly one of {free, active, cached}; the
+        hash↔block maps a bijection; cached blocks registered at
+        refcount 0; and, when the caller supplies the refcounts its own
+        bookkeeping implies (one per sequence referencing the block),
+        an EXACT match against the internal counts."""
+        free, active = set(self._free), set(self._ref)
+        cached = set(self._evictable)
+        if len(free) != len(self._free):
+            raise ValueError("free list contains duplicates")
+        for name, ids in (("free", free), ("active", active),
+                          ("cached", cached)):
+            bad = [b for b in ids if not 0 <= b < self.num_blocks]
+            if bad:
+                raise ValueError(f"{name} ids out of range: {bad}")
+        overlaps = (free & active) | (free & cached) | (active & cached)
+        if overlaps:
+            raise ValueError(f"blocks in multiple states: {sorted(overlaps)}")
+        if len(free) + len(active) + len(cached) != self.num_blocks:
+            raise ValueError(
+                f"state partition covers {len(free) + len(active) + len(cached)}"
+                f" of {self.num_blocks} blocks")
+        if any(c <= 0 for c in self._ref.values()):
+            raise ValueError("active block with non-positive refcount")
+        inv = {b: h for h, b in self._hash_to_block.items()}
+        if inv != self._block_to_hash:
+            raise ValueError("prefix index hash<->block maps disagree")
+        unregistered = cached - set(self._block_to_hash)
+        if unregistered:
+            raise ValueError(
+                f"cached blocks missing from the index: {sorted(unregistered)}")
+        registered_free = free & set(self._block_to_hash)
+        if registered_free:
+            raise ValueError(
+                f"free blocks still indexed: {sorted(registered_free)}")
+        if expected_refcounts is not None:
+            expected = {int(b): int(c) for b, c in expected_refcounts.items()
+                        if int(c) > 0}
+            if expected != self._ref:
+                raise ValueError(
+                    f"refcounts diverge from caller bookkeeping: "
+                    f"expected {expected}, allocator holds {self._ref}")
+
 
 def blocks_needed(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))

@@ -14,7 +14,11 @@ loop with bit-identical math — the certification baseline used by
 tests and ``bench_train_step``.
 """
 
-from apex_tpu.train.loop import TrainLoop  # noqa: F401
+from apex_tpu.train.loop import (  # noqa: F401
+    NonFiniteLossError,
+    TrainLoop,
+    WatchdogConfig,
+)
 from apex_tpu.train.step import (  # noqa: F401
     ReferenceLoop,
     TrainState,

@@ -332,12 +332,13 @@ class TrainStep:
         _check_batch(batch, self.accum_steps)
         return lowered_alias_stats(self._jitted, state, batch)
 
-    def loop(self, state: TrainState):
+    def loop(self, state: TrainState, **kwargs):
         """A deferred-metrics :class:`apex_tpu.train.TrainLoop` over this
-        step, starting from ``state``."""
+        step, starting from ``state``; keyword arguments (fault plan,
+        retry, watchdog, checkpoint knobs) forward to the loop."""
         from apex_tpu.train.loop import TrainLoop
 
-        return TrainLoop(self, state)
+        return TrainLoop(self, state, **kwargs)
 
 
 def build_train_step(

@@ -1,3 +1,12 @@
+from apex_tpu.utils.faults import (  # noqa: F401
+    TRANSIENT_ERRORS,
+    DispatchFailedError,
+    FaultPlan,
+    FaultSpec,
+    SimulatedCrash,
+    TransientDispatchError,
+    nan_corrupt,
+)
 from apex_tpu.utils.pytree import (  # noqa: F401
     all_finite,
     flatten_buckets,

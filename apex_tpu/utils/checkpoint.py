@@ -90,6 +90,43 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
+# TrainState tier: the donated fused-step carry as one named tree
+# ---------------------------------------------------------------------------
+
+
+def save_train_state(directory: str, state) -> str:
+    """Save a :class:`~apex_tpu.train.TrainState` (or any pytree with a
+    ``.step`` scalar leaf) under ``directory/step_NNNNNNNNN``.
+
+    The state is **host-copied first** (``jax.device_get``): a donated
+    state's device buffers are consumed by the next dispatch, so the
+    checkpoint must own its memory — and the copy doubles as the sync
+    point guaranteeing every dispatched step reflected in ``state``
+    has actually executed. Returns the checkpoint path."""
+    import numpy as np
+
+    host = jax.device_get(state)
+    step = int(np.asarray(host.step))
+    return save_checkpoint(directory, step, train_state=host)
+
+
+def load_train_state(directory: str, template_state,
+                     step: Optional[int] = None):
+    """Restore a :func:`save_train_state` checkpoint (``step=None`` →
+    latest) as ``(state, step)``. ``template_state`` supplies the tree
+    structure — a fresh ``TrainStep.init(params)`` result works (its
+    values are never read, only its containers/dtypes/shapes). Leaves
+    come back as device arrays; resuming a loop from the result is
+    bit-identical to the uninterrupted run (tests/test_faults.py)."""
+    import jax.numpy as jnp
+
+    restored = load_checkpoint(directory, step=step,
+                               template=dict(train_state=template_state))
+    state = jax.tree.map(jnp.asarray, restored["train_state"])
+    return state, int(restored["_step"])
+
+
+# ---------------------------------------------------------------------------
 # fused-qkv <-> split-q/k/v checkpoint remapping
 # ---------------------------------------------------------------------------
 #

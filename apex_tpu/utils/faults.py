@@ -1,0 +1,257 @@
+"""Deterministic fault injection for chaos-testing the dispatch paths.
+
+A production engine's failure story is only as good as its tests, and
+failure tests are only as good as their reproducibility: "the decode
+dispatch died once under load" is not a regression test. This module
+makes faults *data* — a :class:`FaultPlan` is a seeded, declarative
+schedule of failures keyed by **call site** (a string like ``"decode"``
+or ``"train_step"``) and **call index** at that site, so a chaos run is
+exactly as replayable as the bit-deterministic serving/training runs it
+attacks (docs/robustness.md).
+
+Three fault kinds, mirroring the three ways a dispatch actually dies:
+
+- ``"transient"`` — raise :class:`TransientDispatchError` *instead of*
+  running the dispatch: the compile-service tunnel dropped, the runtime
+  hiccuped, a retry would succeed. Consumers retry with bounded backoff
+  (the engine's ``max_dispatch_retries``, :class:`TrainLoop`'s
+  ``max_retries``) and escalate when retries exhaust.
+- ``"nan"`` — let the dispatch run, then corrupt the float leaves of
+  its output (or hand the flag back to the caller, who knows which
+  output is the loss): the silent failure mode — a poisoned batch, a
+  numerically-dead layer — that no exception ever surfaces. Consumers
+  watch for it (the train loop's non-finite-loss watchdog).
+- ``"crash"`` — raise :class:`SimulatedCrash`: process death at a
+  chosen step. Nothing catches this (that is the point); tests catch it
+  at top level and prove recovery from the last snapshot/checkpoint is
+  bit-identical to the uninterrupted run.
+
+The plan fires BEFORE the wrapped call for ``transient``/``crash``
+(the dispatch never launches, so no donated buffer is consumed and the
+caller's retry sees intact state) and AFTER it for ``nan``.
+
+Determinism: exact-index triggers (``at=``, ``every=``) depend only on
+the per-site call count; probabilistic triggers (``prob=``) draw from
+one ``random.Random(seed)`` in call order, which is deterministic
+whenever the instrumented program's call order is — true for the
+serving engine and the train loop by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_FAULT_KINDS = ("transient", "nan", "crash")
+
+
+class TransientDispatchError(RuntimeError):
+    """An injected (or real) dispatch failure a retry may cure."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Injected process death. Never caught by the engine or the train
+    loop — it unwinds the whole driver, exactly like a SIGKILL would,
+    and recovery must come from a snapshot/checkpoint."""
+
+
+class DispatchFailedError(RuntimeError):
+    """A dispatch site kept failing after every allotted retry.
+
+    Raised by retrying consumers (not by the plan itself) once backoff
+    is exhausted; carries the site and attempt count so the caller can
+    quarantine whatever work unit kept poisoning the dispatch."""
+
+    def __init__(self, site: str, attempts: int, last: Exception):
+        super().__init__(
+            f"dispatch site {site!r} failed {attempts} consecutive "
+            f"attempt(s); last error: {type(last).__name__}: {last}")
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+
+
+def _transient_error_types() -> Tuple[type, ...]:
+    """The exception types a retry is allowed to eat: the injected kind
+    plus the runtime's real dispatch-failure type (jaxlib's
+    XlaRuntimeError when present — the compile-tunnel/runtime errors
+    bench.py's retry history was built on)."""
+    types: List[type] = [TransientDispatchError]
+    try:  # jaxlib >= 0.4: the one runtime-error type PJRT raises
+        from jaxlib.xla_extension import XlaRuntimeError  # type: ignore
+
+        types.append(XlaRuntimeError)
+    except Exception:  # pragma: no cover - vintage-dependent
+        pass
+    return tuple(types)
+
+
+TRANSIENT_ERRORS: Tuple[type, ...] = _transient_error_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One declarative fault rule.
+
+    Fires at ``site`` on call indices listed in ``at`` (0-based), on
+    every ``every``-th call (indices ``every-1, 2*every-1, ...``), or
+    with probability ``prob`` per call (seeded draw); ``max_fires``
+    bounds the total (None = unbounded). A spec with none of the three
+    triggers never fires."""
+
+    site: str
+    kind: str
+    at: Tuple[int, ...] = ()
+    every: Optional[int] = None
+    prob: float = 0.0
+    max_fires: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in _FAULT_KINDS:
+            raise ValueError(
+                f"kind must be one of {_FAULT_KINDS}, got {self.kind!r}")
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        # tuples survive dataclass frozen-ness; normalize lists for
+        # callers who wrote at=[3]
+        object.__setattr__(self, "at", tuple(int(i) for i in self.at))
+
+
+class FaultPlan:
+    """A seeded schedule of :class:`FaultSpec` rules.
+
+    Consumers call :meth:`fire` once per guarded call site invocation,
+    BEFORE the dispatch: ``transient``/``crash`` rules raise there,
+    ``nan`` rules make it return True and the caller corrupts the
+    output it knows to be floating-point (or uses :meth:`wrap`, which
+    NaN-fills every inexact array leaf). ``fired`` keeps the full audit
+    log; ``counts`` aggregates it for assertions.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+        import random
+
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._calls: Dict[str, int] = {}
+        self._spec_fires = [0] * len(self.specs)
+        self.fired: List[Tuple[str, str, int]] = []  # (site, kind, index)
+
+    def calls(self, site: str) -> int:
+        """How many times ``site`` has been guarded so far."""
+        return self._calls.get(site, 0)
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """``{site: {kind: fire_count}}`` over the whole run."""
+        out: Dict[str, Dict[str, int]] = {}
+        for site, kind, _ in self.fired:
+            out.setdefault(site, {}).setdefault(kind, 0)
+            out[site][kind] += 1
+        return out
+
+    def fire(self, site: str) -> bool:
+        """Advance the site's call counter and apply matching rules.
+
+        Raises for ``transient``/``crash`` hits; returns True when a
+        ``nan`` rule hit (the caller owns the corruption). Specs are
+        scanned in declaration order and a raising hit stops the scan,
+        so a later probabilistic spec's RNG draw is skipped on that
+        call — keep at most one probabilistic spec per site when you
+        need draw-for-draw reproducibility across plan edits."""
+        i = self._calls.get(site, 0)
+        self._calls[site] = i + 1
+        nan_hit = False
+        for s_idx, spec in enumerate(self.specs):
+            if spec.site != site:
+                continue
+            if (spec.max_fires is not None
+                    and self._spec_fires[s_idx] >= spec.max_fires):
+                continue
+            hit = i in spec.at
+            if not hit and spec.every is not None:
+                hit = (i + 1) % spec.every == 0
+            if not hit and spec.prob > 0.0:
+                hit = self._rng.random() < spec.prob
+            if not hit:
+                continue
+            self._spec_fires[s_idx] += 1
+            self.fired.append((site, spec.kind, i))
+            if spec.kind == "crash":
+                raise SimulatedCrash(
+                    f"injected crash at site {site!r} call {i}")
+            if spec.kind == "transient":
+                raise TransientDispatchError(
+                    f"injected transient failure at site {site!r} call {i}")
+            nan_hit = True
+        return nan_hit
+
+    def wrap(self, site: str, fn, corrupt=None):
+        """``fn`` guarded by this plan at ``site``. ``corrupt`` maps the
+        output on a ``nan`` hit; the default NaN-fills every inexact
+        (float/complex) array leaf of the output pytree, leaving integer
+        outputs (e.g. sampled token ids) untouched."""
+        if corrupt is None:
+            corrupt = nan_corrupt
+
+        def guarded(*args, **kwargs):
+            nan_hit = self.fire(site)
+            out = fn(*args, **kwargs)
+            return corrupt(out) if nan_hit else out
+
+        return guarded
+
+
+def guarded_call(fn, *args, plan: Optional[FaultPlan] = None,
+                 site: str = "dispatch", retries: int = 0,
+                 backoff_s: float = 0.0, on_retry=None):
+    """THE retry policy both dispatch consumers share (the serving
+    engine's ``_guarded_dispatch``, :class:`TrainLoop`'s step): fire
+    the plan at ``site``, run ``fn(*args)``, retry transient failures
+    up to ``retries`` times sleeping ``backoff_s * 2**attempt`` between
+    tries (``on_retry(attempt)`` is the caller's counter hook), and
+    raise :class:`DispatchFailedError` on exhaustion.
+    :class:`SimulatedCrash` is never caught — it is process death.
+
+    Returns ``(result, nan_hit)`` — ``nan_hit`` is the plan's silent-
+    corruption flag, for callers that know which output is the loss.
+    Retry soundness is the caller's contract: ``fn``'s inputs must be
+    intact after a failed attempt (true when the failure precedes
+    buffer consumption — injected faults and launch-time errors; a
+    consumed donated buffer raises non-transient on the retry and
+    propagates)."""
+    last = None
+    for attempt in range(retries + 1):
+        if attempt:
+            if on_retry is not None:
+                on_retry(attempt)
+            if backoff_s > 0.0:
+                time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            nan_hit = plan.fire(site) if plan is not None else False
+            return fn(*args), nan_hit
+        except SimulatedCrash:
+            raise
+        except TRANSIENT_ERRORS as e:
+            last = e
+    raise DispatchFailedError(site, retries + 1, last)
+
+
+def nan_corrupt(tree):
+    """NaN-fill every inexact array leaf of ``tree`` (the default
+    ``nan`` corruption): the shape/dtype-preserving analog of a batch
+    whose activations went non-finite."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def leaf(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(
+                np.dtype(x.dtype), np.inexact):
+            return jnp.full(jnp.shape(x), jnp.nan, x.dtype)
+        return x
+
+    return jax.tree.map(leaf, tree)

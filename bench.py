@@ -316,6 +316,41 @@ def _reset():
     gc.collect()
 
 
+def _emit_section_record(name, status, wall_s, error=None):
+    """One `{"section": ...}` JSON line per bench section: wall time +
+    exit status, emitted whether the section lived or died. BENCH_r01
+    and r05 lost whole rounds to sections that crashed and simply left
+    NOTHING in the artifact — a dead section must be a visible record
+    ("status": "failed" + the error), not an absence someone has to
+    diff against the previous round to notice."""
+    rec = {"section": name, "status": status,
+           "wall_time_s": round(wall_s, 3)}
+    if error is not None:
+        rec["error"] = error
+    print(json.dumps(rec))
+
+
+def _run_section(name, fn, retries=1):
+    """Run one bench section with the standard transient retry, print
+    its JSON result, and ALWAYS follow with the section record. Returns
+    True when the section produced a result."""
+    t0 = time.perf_counter()
+    last_err = None
+    for attempt in range(retries + 1):
+        try:
+            print(json.dumps(fn()))
+            _emit_section_record(name, "ok", time.perf_counter() - t0)
+            return True
+        except Exception as e:  # a dying section must not kill the run
+            last_err = f"{type(e).__name__}: {e}"
+            print(f"# {name} attempt {attempt} failed: {e}",
+                  file=sys.stderr)
+            _reset()
+    _emit_section_record(name, "failed", time.perf_counter() - t0,
+                         error=last_err)
+    return False
+
+
 def _measure(batch, seq, iters, with_baseline=True, remat=True):
     """(optimized dt, baseline dt or None, mfu) at one shape."""
     _reset()
@@ -1237,12 +1272,8 @@ def main():
              lambda: bench_serving_multistep(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
         ):
-            try:
-                print(json.dumps(fn()))
-            except Exception as e:
+            if not _run_section(name, fn, retries=0):
                 failed.append(name)
-                print(f"# --smoke section {name} FAILED: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr)
             _reset()
         if failed:
             print(f"# --smoke: {len(failed)} section(s) failed: "
@@ -1263,6 +1294,7 @@ def main():
     # one retry: a transient tunnel drop mid-headline (compile-service
     # restarts were observed in round 5) must not zero out the whole
     # recorded round
+    t_headline = time.perf_counter()
     for attempt in (0, 1):
         try:
             dt_opt, dt_base, mfu = _measure(batch, seq, iters=8,
@@ -1270,6 +1302,11 @@ def main():
             break
         except Exception as e:
             if attempt:
+                # the record of the death IS the artifact here: the
+                # re-raise kills the run, so write the section line first
+                _emit_section_record("headline", "failed",
+                                     time.perf_counter() - t_headline,
+                                     error=f"{type(e).__name__}: {e}")
                 raise
             print(f"# headline attempt 0 failed ({e}); retrying",
                   file=sys.stderr)
@@ -1288,6 +1325,8 @@ def main():
         "vs_baseline": round(dt_base / dt_opt, 3),
     }
     print(json.dumps(result))
+    _emit_section_record("headline", "ok",
+                         time.perf_counter() - t_headline)
     # BASELINE configs[1]-[3] + the serving section (round 6) + the
     # long-context attention record (S=4096 on TPU by default; add
     # S=2048 with --long-context)
@@ -1309,14 +1348,10 @@ def main():
             secondary.append(bench_long_context_s2048)
     _reset()
     for bench_fn in secondary:
-        for attempt in (0, 1):  # one retry: the remote-compile tunnel
-            try:                # occasionally drops a response mid-read
-                print(json.dumps(bench_fn()))
-                break
-            except Exception as e:  # secondary metric must not kill the run
-                print(f"# {bench_fn.__name__} attempt {attempt} failed: {e}",
-                      file=sys.stderr)
-                _reset()
+        # one retry: the remote-compile tunnel occasionally drops a
+        # response mid-read; a secondary metric must not kill the run,
+        # and its death must leave a "failed" section record
+        _run_section(bench_fn.__name__, bench_fn, retries=1)
         _reset()
 
 
