@@ -28,7 +28,18 @@ the block table. The engine's multi-step decode traces this one-token
 call once as the body of a ``jax.lax.scan`` (K fused iterations per
 dispatch), so everything here must be — and is — shape-stable under
 traced ``cache_positions``/``seq_lens`` that advance inside the loop.
-See :mod:`apex_tpu.serving` and docs/serving.md.
+The same multi-token path doubles as the speculative-decoding
+**verify-mode forward**: a ``[B, spec_tokens + 1]`` call whose per-lane
+``cache_positions`` start at each lane's own context offset scores a
+whole drafted span in one dispatch — the chunk writes the carried
+token's and every draft's K/V through the block table and attends
+causally by absolute position, so position ``p``'s logits are exactly
+the target distribution given drafts ``0..p-1``. Lanes whose proposal
+count falls short of the chunk ride with PADDED trailing queries:
+their writes are suppressed by ``seq_lens``/``write_start`` and their
+logits ignored, but their (clamped) position lookups must stay
+in-range — see :class:`GPTModel`. See :mod:`apex_tpu.serving` and
+docs/serving.md.
 """
 
 from __future__ import annotations
@@ -153,7 +164,11 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
       FULL cached context through the block table — the shared-prefix
       blocks matched at admission, earlier chunks, and the chunk itself
       — via :func:`apex_tpu.ops.flash_attention.paged_prefill_attention`
-      (causal by absolute position, padding key-masked by ``seq_lens``);
+      (causal by absolute position, padding key-masked by ``seq_lens``).
+      Speculative verification is this same mode at ``[B, spec + 1]``:
+      each lane's chunk holds its carried token plus its drafted span
+      at per-lane absolute positions, so one forward scores every
+      candidate position against the drafts before it;
     - decode (S == 1): single-query attention against the block table
       via :func:`apex_tpu.ops.flash_attention.paged_decode_attention`.
     ``write_start`` (``[B]`` int32, optional) suppresses cache writes
@@ -322,7 +337,17 @@ class GPTModel(nn.Module):
                 raise ValueError(
                     "kv_cache requires block_tables, cache_positions, "
                     "and seq_lens")
-            pos = jnp.take(wpe, cache_positions, axis=0)   # [B, S, H]
+            # clamp explicitly: verify-mode chunks carry PADDING
+            # positions past a lane's real span (draft slots beyond its
+            # proposal count, whose writes are suppressed and logits
+            # ignored) which may run past the embedding table near the
+            # sequence cap — the gather must not depend on jit's
+            # implicit out-of-bounds clamping for its correctness story
+            pos = jnp.take(
+                wpe,
+                jnp.minimum(cache_positions,
+                            cfg.max_position_embeddings - 1),
+                axis=0)                                    # [B, S, H]
             x = (wte[input_ids] + pos).astype(cfg.dtype)
             for i in range(cfg.num_layers):
                 x, kv_cache = GPTBlock(cfg, False, name=f"h_{i}")(

@@ -10,6 +10,11 @@ decode path lives in :mod:`apex_tpu.ops.flash_attention`, and the model
 hook is ``GPTLMHeadModel.apply(..., kv_cache=...)``.
 """
 
+from apex_tpu.serving.drafter import (  # noqa: F401
+    Drafter,
+    GPTDrafter,
+    NgramDrafter,
+)
 from apex_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
     EngineStalledError,
@@ -36,4 +41,5 @@ from apex_tpu.serving.sampling import (  # noqa: F401
     SamplingParams,
     sample_tokens,
     sample_tokens_per_lane,
+    spec_verify_tokens,
 )

@@ -1,0 +1,176 @@
+"""Draft-token proposers for speculative decoding (docs/serving.md).
+
+Speculative decoding splits token generation into a cheap **proposer**
+and the engine's one-dispatch **verifier**: a drafter guesses up to
+``spec_tokens`` continuation tokens per lane, the target model scores
+every candidate position in a single forward, and the rejection rule in
+:func:`apex_tpu.serving.sampling.spec_verify_tokens` accepts a prefix of
+the guesses without changing the output distribution (bit-identically,
+for greedy). The drafter therefore has exactly one obligation beyond
+the ``propose`` signature: it must be a **pure function of the token
+history** — so a run is reproducible, and so the greedy certification
+(speculative output bit-identical to non-speculative greedy across
+lane placements and preemption/resume) holds; sampled lanes stay
+exactly distribution-preserving, though their realized draws depend on
+where span boundaries fall (docs/serving.md). Proposal *quality* only
+affects throughput, never correctness: every rejected token is
+corrected from the target distribution.
+
+Two drafters ship behind the interface:
+
+- :class:`NgramDrafter` — prompt-lookup / n-gram matching (the
+  "assisted generation" trick): find the longest recent-suffix n-gram
+  that occurred earlier in the history and propose the tokens that
+  followed it. Zero model cost, zero device work, and very effective on
+  the traffic speculative decoding targets — templated output,
+  multi-turn echoes, code, and the repetition attractors greedy
+  decoding falls into.
+- :class:`GPTDrafter` — a small GPT (same
+  :class:`~apex_tpu.models.gpt.GPTLMHeadModel` contract) greedy-decoding
+  the continuation over a fixed context window. One jitted program at
+  one fixed shape, so the drafter cannot erode the engine's pinned
+  compile counts; it runs its window forward once per proposed token
+  (no KV cache of its own — the drafter is meant to be small enough
+  that this is still cheap next to one target-model decode step).
+
+A drafter that raises is **quarantined**, not fatal: the engine wraps
+``propose`` in the shared retry policy
+(:func:`apex_tpu.utils.faults.guarded_call`) and permanently degrades
+to non-speculative decoding when retries exhaust — the verify program
+with zero proposals is exactly a single decode step, so a drafterless
+speculative engine keeps emitting bit-identical tokens.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class Drafter:
+    """The proposer interface: ``propose(history, max_tokens)`` returns
+    up to ``max_tokens`` candidate continuation tokens for a sequence
+    whose full visible token history (prompt + everything generated) is
+    ``history``. Fewer — including zero — proposals are always legal;
+    the engine verifies whatever it gets and falls back to an ordinary
+    single-token step for lanes with no proposals. Implementations must
+    be deterministic in ``history`` (see the module docstring)."""
+
+    def propose(self, history: Sequence[int],
+                max_tokens: int) -> List[int]:
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup drafting: propose the continuation of the most
+    recent earlier occurrence of the history's suffix n-gram.
+
+    For ``n`` from ``max_ngram`` down to ``min_ngram``, the drafter
+    looks for the latest earlier position where the history's final
+    ``n`` tokens already appeared; on a match it proposes the tokens
+    that followed that occurrence, in order. Matching longest-suffix
+    first keeps proposals conservative (a longer context match is a
+    stronger signal); searching latest-first prefers the freshest
+    continuation when a pattern occurs more than once. A continuation
+    that runs into the present **extends periodically** (the proposals
+    feed themselves): a greedy decode circling a repetition attractor
+    matches its suffix one period back, where the raw continuation is
+    at most one period long — wrapping turns that into a full
+    ``max_tokens`` proposal, and the verify chunk's shape is fixed at
+    ``spec_tokens + 1`` either way, so the extra guesses ride the
+    dispatch for free and a wrong tail merely gets rejected. No match
+    — or a history shorter than ``min_ngram + 1`` — proposes nothing,
+    which costs one ordinary decode step.
+
+    Pure Python over host token lists: O(len(history) * max_ngram) per
+    call, negligible next to a model dispatch at serving context
+    lengths (the engine calls it once per decoding lane per decode
+    dispatch, i.e. once per speculative span, not per token).
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min_ngram={min_ngram}, max_ngram={max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history: Sequence[int],
+                max_tokens: int) -> List[int]:
+        toks = list(history)
+        L = len(toks)
+        if max_tokens < 1:
+            return []
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            suffix = toks[L - n:]
+            # latest EARLIER occurrence: start positions where the
+            # match's continuation is not the suffix itself
+            for s in range(L - n - 1, -1, -1):
+                if toks[s:s + n] == suffix:
+                    out: List[int] = []
+                    pos = s + n
+                    while len(out) < max_tokens:
+                        # past the present, the continuation is the
+                        # proposal itself: periodic extension
+                        out.append(toks[pos] if pos < L
+                                   else out[pos - L])
+                        pos += 1
+                    return out
+        return []
+
+
+class GPTDrafter(Drafter):
+    """A small-GPT proposer: greedy-decode ``max_tokens`` continuation
+    tokens with a (cheaper) draft model over the last ``window`` tokens
+    of the history.
+
+    The draft model follows the same ``GPTLMHeadModel`` apply contract
+    as the target, with its own params — typically far fewer layers /
+    a narrower width. The forward runs at ONE fixed ``[1, window]``
+    shape (right-padded, logits read at the last real position — causal
+    attention makes the padding invisible), so the drafter owns exactly
+    one compiled program for the engine's lifetime. Each proposed token
+    is one window forward; there is deliberately no drafter-side KV
+    cache — the drafter must be small enough that recompute is cheap,
+    and keeping it stateless preserves the pure-function-of-history
+    contract preemption/resume determinism requires.
+    """
+
+    def __init__(self, model, params, window: int = 32):
+        import jax
+        import jax.numpy as jnp
+
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window > model.cfg.max_position_embeddings:
+            raise ValueError(
+                f"window ({window}) exceeds the draft model's "
+                f"max_position_embeddings "
+                f"({model.cfg.max_position_embeddings})")
+        self.model = model
+        self.params = params
+        self.window = int(window)
+
+        def _next_token(params, ids, last_idx):
+            logits = self.model.apply(params, ids, deterministic=True)
+            return jnp.argmax(
+                logits[0, last_idx].astype(jnp.float32)).astype(jnp.int32)
+
+        self._next = jax.jit(_next_token)
+
+    def propose(self, history: Sequence[int],
+                max_tokens: int) -> List[int]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        toks = [int(t) for t in history]
+        out: List[int] = []
+        for _ in range(max(int(max_tokens), 0)):
+            w = toks[-self.window:]
+            ids = np.zeros((1, self.window), np.int32)
+            ids[0, : len(w)] = w
+            nxt = int(self._next(self.params, jnp.asarray(ids),
+                                 jnp.int32(len(w) - 1)))
+            out.append(nxt)
+            toks.append(nxt)
+        return out

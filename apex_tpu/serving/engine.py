@@ -38,6 +38,24 @@ mid-traffic. The engine therefore holds a **fixed-program contract**:
   append into a block it shares with another sequence — compiled
   lazily, only if copy-on-write ever triggers.
 
+**Speculative decoding** (``spec_tokens > 0``, docs/serving.md) swaps
+the decode program — same slot in the contract, still exactly one
+compilation — for draft-and-verify: a host-side drafter
+(:mod:`~apex_tpu.serving.drafter`, prompt-lookup by default) proposes
+up to ``spec_tokens`` continuation tokens per lane each decode phase,
+and ONE ``[max_batch, spec_tokens + 1]`` target forward scores every
+candidate position through the multi-query paged-prefill path, accepts
+a per-lane prefix on-device (the Leviathan et al. rejection rule,
+:func:`~apex_tpu.serving.sampling.spec_verify_tokens`), and emits
+``1..spec_tokens + 1`` tokens per dispatch under the same ``-1``
+sentinel/stop-mask conventions — the deferred-drain contract below is
+untouched, the host just advances each lane by its own emitted count.
+Blocks are reserved for the worst case (every proposal written) and
+the drain returns what rejection stranded
+(:meth:`~apex_tpu.serving.kv_cache.BlockAllocator.trim_to`). Greedy
+output is bit-identical to non-speculative greedy; a crashing drafter
+is quarantined and the engine degrades to non-speculative decoding.
+
 Everything that varies between steps — which slots are live, block
 tables, chunk offsets, context lengths, sampling knobs — varies as
 *array values*, so XLA compiles one program per shape for the lifetime
@@ -128,10 +146,12 @@ from apex_tpu.serving.kv_cache import (
     device_block_table,
     hash_block_tokens,
 )
+from apex_tpu.serving.drafter import NgramDrafter
 from apex_tpu.serving.sampling import (
     SamplingParams,
     sample_tokens,
     sample_tokens_per_lane,
+    spec_verify_tokens,
 )
 
 
@@ -225,7 +245,47 @@ class EngineConfig:
     # request is quarantined with terminal status "failed".
     max_dispatch_retries: int = 2
     retry_backoff_s: float = 0.0
+    # Speculative decoding (docs/serving.md): > 0 swaps the K-step
+    # decode scan for draft-and-verify — a host-side drafter proposes
+    # up to spec_tokens continuation tokens per lane, and ONE target
+    # forward over [max_batch, spec_tokens + 1] scores every candidate
+    # position, accepts a prefix on-device (rejection rule in
+    # sampling.spec_verify_tokens), and emits 1..spec_tokens + 1 tokens
+    # per dispatch. Greedy output is bit-identical to non-speculative
+    # greedy; sampled output is exactly distribution-preserving (its
+    # realized draws depend on span boundaries — docs/serving.md).
+    # decode_steps is ignored while speculation is on: the verify
+    # forward IS the dispatch, there is no scan to fuse.
+    spec_tokens: int = 0
     seed: int = 0
+
+    def __post_init__(self):
+        # construction-time validation: a bad geometry knob used to
+        # surface as a shape error deep inside the first dispatch —
+        # fail here, with the knob's name, instead
+        for name in ("max_batch", "block_size", "num_blocks",
+                     "max_seq_len", "max_prefill_len"):
+            v = getattr(self, name)
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        chunk = (self.prefill_chunk if self.prefill_chunk is not None
+                 else self.max_prefill_len)
+        if chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {chunk}")
+        if chunk > self.max_seq_len:
+            raise ValueError(
+                f"prefill_chunk ({chunk}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        if self.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {self.decode_steps}")
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {self.spec_tokens}")
+        if self.max_dispatch_retries < 0:
+            raise ValueError(
+                f"max_dispatch_retries must be >= 0, got "
+                f"{self.max_dispatch_retries}")
 
 
 @dataclasses.dataclass
@@ -288,21 +348,23 @@ class InferenceEngine:
     """
 
     def __init__(self, model, params, config: EngineConfig, *,
-                 faults=None, clock=None):
+                 drafter=None, faults=None, clock=None):
         cfg = model.cfg
         self.model = model
         self.params = params
         self.config = config
         # optional chaos harness (apex_tpu.utils.faults.FaultPlan): every
-        # jitted dispatch fires the plan at its site ("prefill"/"decode")
-        # before launching, so chaos tests are seeded and reproducible
+        # jitted dispatch fires the plan at its site ("prefill"/"decode",
+        # plus "draft" around the speculative proposer) before
+        # launching, so chaos tests are seeded and reproducible
         self.faults = faults
         if faults is not None:
             # the engine's outputs are integer tokens, so there is no
             # float output the "nan" kind could meaningfully corrupt —
             # reject rather than record a fire that changed nothing
             bad = [s.site for s in getattr(faults, "specs", ())
-                   if s.kind == "nan" and s.site in ("prefill", "decode")]
+                   if s.kind == "nan"
+                   and s.site in ("prefill", "decode", "draft")]
             if bad:
                 raise ValueError(
                     f"nan faults are not supported at serving sites "
@@ -312,12 +374,23 @@ class InferenceEngine:
         self._clock = time.monotonic if clock is None else clock
         self._chunk = (config.prefill_chunk if config.prefill_chunk
                        is not None else config.max_prefill_len)
-        if self._chunk < 1:
-            raise ValueError("prefill_chunk must be >= 1")
-        if self._chunk > config.max_seq_len:
-            raise ValueError("prefill_chunk exceeds max_seq_len")
-        if config.decode_steps < 1:
-            raise ValueError("decode_steps must be >= 1")
+        # speculative decoding: the drafter defaults to prompt-lookup;
+        # a custom one rides the same propose() contract (drafter.py)
+        if config.spec_tokens > 0:
+            self.drafter = NgramDrafter() if drafter is None else drafter
+        elif drafter is not None:
+            raise ValueError(
+                "a drafter requires spec_tokens >= 1 (speculative "
+                "decoding is off at spec_tokens == 0)")
+        else:
+            self.drafter = None
+        # flipped off forever if the drafter is quarantined: the verify
+        # program with zero proposals is a plain single-token step, so
+        # the engine degrades to non-speculative decoding, not death
+        self._drafter_ok = config.spec_tokens > 0
+        # the coming dispatch's proposals: {lane: [token, ...]},
+        # rebuilt every decode phase (step 4), consumed by the dispatch
+        self._draft_plan: Dict[int, List[int]] = {}
         if config.max_seq_len > cfg.max_position_embeddings:
             raise ValueError(
                 f"max_seq_len ({config.max_seq_len}) exceeds the model's "
@@ -351,6 +424,11 @@ class InferenceEngine:
         self._num_timeouts = 0
         self._num_dispatch_retries = 0
         self._num_quarantines = 0
+        self._num_draft_tokens = 0
+        self._num_accepted_tokens = 0
+        self._num_draft_retries = 0
+        self._num_drafter_quarantines = 0
+        self._num_spec_blocks_rolled_back = 0
         self._num_snapshots = 0
         self._num_restores = 0
         self._fetch_failures = 0   # consecutive failed deferred drains
@@ -368,9 +446,16 @@ class InferenceEngine:
         # the fixed program set; anything else jitted here would break
         # the compile-count contract the tests pin. Arg 1 is the cache
         # pool in every signature (donated when the runtime allows).
+        # With speculation on, THE decode program is the verify program
+        # — same slot in the contract, still exactly one compilation
+        # (zero-proposal lanes run through it as single-token steps, so
+        # no second "fallback" program ever exists).
         donate = (1,) if config.donate_cache else ()
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate)
-        self._decode = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._decode = jax.jit(
+            self._spec_decode_impl if config.spec_tokens > 0
+            else self._decode_impl,
+            donate_argnums=donate)
         self._cow = jax.jit(
             copy_block, donate_argnums=(0,) if config.donate_cache else ())
 
@@ -437,6 +522,70 @@ class InferenceEngine:
             body, (cache, tokens, context_lens, budgets, gen_counts),
             None, length=self.config.decode_steps)
         return cache, toks.T
+
+    def _spec_decode_impl(self, params, cache, tokens, drafts, draft_lens,
+                          tables, context_lens, budgets, gen_counts,
+                          eos_ids, lane_keys, temp, top_k, top_p):
+        """Draft-and-verify decode: ONE target forward scores a whole
+        drafted span per lane (``spec_tokens > 0`` replaces the K-step
+        scan with this program).
+
+        Each lane's query chunk is its carried token followed by its
+        ``draft_lens`` proposals, at absolute positions ``ctx .. ctx +
+        d`` — the multi-query paged-prefill path, so position ``p``'s
+        logits are exactly the target distribution given the drafts
+        before it, and the chunk's K/V (the carried token's AND every
+        draft's) scatter into the lane's reserved span in the same
+        dispatch. The accept rule
+        (:func:`~apex_tpu.serving.sampling.spec_verify_tokens`) keeps a
+        prefix of the drafts and samples the correction/bonus token
+        with the lane's schedule-invariant per-token keys; the same
+        stop-mask conventions as the scan then apply — inactive lanes
+        emit nothing (and ``write_start`` drops their writes), an
+        accepted/emitted EOS truncates the lane's remaining span, and
+        the program returns ``[max_batch, spec_tokens + 1]`` tokens
+        with ``-1`` sentinels past each lane's emitted prefix, so the
+        deferred-drain contract is byte-for-byte the scan's.
+
+        Rejected drafts need no device-side rollback: their K/V sits at
+        positions past the lane's new context length, which every
+        attention mask already excludes, and the next dispatch's writes
+        land over them before the context ever reaches those positions.
+        (The HOST-side reservation rollback — returning span blocks the
+        rejection stranded — happens at drain time via
+        ``BlockAllocator.trim_to``.)
+        """
+        B = self.config.max_batch
+        P = self.config.spec_tokens + 1
+        act = budgets > 0
+        q_ids = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        pos = (context_lens[:, None]
+               + jax.lax.broadcasted_iota(jnp.int32, (B, P), 1))
+        # the lane's span: carried token + its proposals; padded query
+        # slots past it are masked (no write, ignored logits)
+        seq_lens = context_lens + 1 + draft_lens
+        write_start = jnp.where(act, context_lens, context_lens + P + 1)
+        logits, cache = self.model.apply(
+            params, q_ids, deterministic=True, kv_cache=cache,
+            block_tables=tables, cache_positions=pos, seq_lens=seq_lens,
+            write_start=write_start)
+        token_idx = (gen_counts[:, None]
+                     + jax.lax.broadcasted_iota(jnp.int32, (B, P), 1))
+        emitted, n_emit = spec_verify_tokens(
+            logits, drafts, draft_lens, lane_keys, token_idx, temp,
+            top_k, top_p)
+        # stop masks, mirroring the scan: emit only the accepted-prefix
+        # + correction window, cut everything after the first EOS, and
+        # mask inactive lanes entirely. All three are prefix masks, so
+        # the host's count-by-sentinel-prefix drain stays valid.
+        ii = jax.lax.broadcasted_iota(jnp.int32, (B, P), 1)
+        within = ii < n_emit[:, None]
+        is_eos = (within & (eos_ids[:, None] >= 0)
+                  & (emitted == eos_ids[:, None]))
+        after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                     - is_eos.astype(jnp.int32)) > 0
+        keep = within & ~after_eos & act[:, None]
+        return cache, jnp.where(keep, emitted, jnp.int32(-1))
 
     # -- host-side scheduling ---------------------------------------------
 
@@ -632,6 +781,7 @@ class InferenceEngine:
             self.slots[i] = None
         self.allocator.reset()
         self.cache = jax.tree.map(jnp.zeros_like, self.cache)
+        self._draft_plan = {}   # its lanes no longer exist
         self._invalidate_lanes()
 
     def _guarded_dispatch(self, site: str, fn, *args):
@@ -838,6 +988,76 @@ class InferenceEngine:
                 self._record_token(idx, tok0)
         return True
 
+    # -- speculative drafting (docs/serving.md) ----------------------------
+
+    def _build_draft_plan(self, active: List[int]) -> None:
+        """Ask the drafter for up to ``spec_tokens`` proposals per
+        decoding lane — the host half of draft-and-verify, run once per
+        decode phase BEFORE the span reservation (the reservation is
+        sized by each lane's proposal count).
+
+        Per lane the proposal budget is ``min(spec_tokens, remaining -
+        1)``: capping one under the lane's remaining ``max_new_tokens``
+        means the verify program can never emit past the budget (it
+        emits at most ``proposals + 1`` tokens), which also keeps every
+        span write inside ``max_seq_len`` (``add_request`` bounds
+        ``prompt + max_new_tokens``). Proposals are sanitized — the
+        drafter is third-party code — by truncating at the first token
+        outside the vocabulary.
+
+        The drafter runs under the shared retry policy
+        (:func:`~apex_tpu.utils.faults.guarded_call`, site ``"draft"``).
+        A drafter that exhausts its retries — or raises anything
+        non-transient — is **quarantined**: ``_drafter_ok`` flips off
+        for the engine's lifetime and every future plan is empty, so
+        the verify program degrades to plain single-token decoding
+        (bit-identically — a zero-proposal verify IS one decode step)
+        instead of the crash killing the engine."""
+        self._draft_plan = {}
+        if not self._drafter_ok:
+            return
+        S = self.config.spec_tokens
+        vocab = self.model.cfg.vocab_size
+        plan: Dict[int, List[int]] = {}
+
+        def count(attempt):
+            self._num_draft_retries += 1
+
+        for i in active:
+            slot = self.slots[i]
+            cap = min(S, slot.request.max_new_tokens
+                      - len(slot.generated) - 1)
+            if cap < 1:
+                continue
+            history = list(slot.request.prompt) + slot.generated
+            try:
+                props, _ = guarded_call(
+                    self.drafter.propose, history, cap,
+                    plan=self.faults, site="draft",
+                    retries=self.config.max_dispatch_retries,
+                    backoff_s=self.config.retry_backoff_s,
+                    on_retry=count)
+            except SimulatedCrash:
+                raise
+            except Exception:
+                # retries exhausted (DispatchFailedError) or a drafter
+                # bug: degrade to non-speculative decoding, permanently
+                self._drafter_ok = False
+                self._num_drafter_quarantines += 1
+                return
+            clean: List[int] = []
+            for t in list(props)[:cap]:
+                t = int(t)
+                if not 0 <= t < vocab:
+                    break
+                clean.append(t)
+            if clean:
+                plan[i] = clean
+        self._draft_plan = plan
+        # num_draft_tokens is counted at DISPATCH, not here: proposals
+        # a preemption or failed dispatch drops before verification
+        # must not dilute the acceptance rate
+
     # -- decode-time block growth, CoW, preemption -------------------------
 
     def _preempt_for(self, requester: int) -> bool:
@@ -867,8 +1087,11 @@ class InferenceEngine:
     def _ensure_decode_blocks(self) -> None:
         """Each started slot is about to write K/V at positions
         ``context_len .. context_len + span - 1`` (``span`` = the
-        coming dispatch's emitted-token bound: ``decode_steps`` capped
-        by the lane's remaining budget) — make sure PRIVATE blocks
+        coming dispatch's write bound: ``decode_steps`` capped by the
+        lane's remaining budget — or, speculating, the carried token
+        plus the lane's proposal count, every candidate K/V landing in
+        the same dispatch whether or not it is accepted) — make sure
+        PRIVATE blocks
         cover the whole span: allocate the missing tail (preempting the
         youngest lane if the pool is dry), and copy-on-write any
         covering block shared with another sequence (a full-block
@@ -884,8 +1107,14 @@ class InferenceEngine:
         for _, i in order:
             while self.slots[i] is not None:
                 slot = self.slots[i]
-                span = min(K, slot.request.max_new_tokens
-                           - len(slot.generated))
+                if self.config.spec_tokens > 0:
+                    # verify-span writes: the carried token + every
+                    # proposal (rejected ones too — the drain trims
+                    # blocks the rejection strands back to the pool)
+                    span = 1 + len(self._draft_plan.get(i, ()))
+                else:
+                    span = min(K, slot.request.max_new_tokens
+                               - len(slot.generated))
                 need = blocks_needed(slot.context_len + span, bs)
                 if len(slot.blocks) < need:
                     try:
@@ -947,6 +1176,7 @@ class InferenceEngine:
         decoding lane remains. A persistent site-wide fault therefore
         fails requests one at a time instead of killing the engine."""
         B = self.config.max_batch
+        spec = self.config.spec_tokens > 0
         while active:
             tokens = np.zeros(B, np.int32)
             ctx = np.zeros(B, np.int32)
@@ -962,12 +1192,29 @@ class InferenceEngine:
             tables = self._dev_tables.get(self._build_decode_tables)
             temp, top_k, top_p, eos, keys = self._dev_lanes.get(
                 self._build_lane_meta)
+            if spec:
+                # this tick's draft plan, as fixed-shape arrays: the
+                # verify program's ONE compiled shape regardless of
+                # how many proposals each lane actually carries
+                drafts = np.zeros((B, self.config.spec_tokens), np.int32)
+                dlens = np.zeros(B, np.int32)
+                for i in active:
+                    p = self._draft_plan.get(i, ())
+                    drafts[i, : len(p)] = p
+                    dlens[i] = len(p)
+                args = (self.params, self.cache, jnp.asarray(tokens),
+                        jnp.asarray(drafts), jnp.asarray(dlens), tables,
+                        jnp.asarray(ctx), jnp.asarray(budgets),
+                        jnp.asarray(gcounts), eos, keys, temp, top_k,
+                        top_p)
+            else:
+                args = (self.params, self.cache, jnp.asarray(tokens),
+                        tables, jnp.asarray(ctx), jnp.asarray(budgets),
+                        jnp.asarray(gcounts), eos, keys, temp, top_k,
+                        top_p)
             try:
                 self.cache, toks = self._guarded_dispatch(
-                    "decode", self._decode,
-                    self.params, self.cache, jnp.asarray(tokens), tables,
-                    jnp.asarray(ctx), jnp.asarray(budgets),
-                    jnp.asarray(gcounts), eos, keys, temp, top_k, top_p)
+                    "decode", self._decode, *args)
             except DispatchFailedError:
                 idx = max((self.slots[i].admit_seq, i) for i in active)[1]
                 self._quarantine_slot(idx)
@@ -975,6 +1222,13 @@ class InferenceEngine:
                           if s is not None and s.started]
                 continue
             self._num_decode_dispatches += 1
+            if spec:
+                # count drafted tokens HERE, for the lanes this
+                # dispatch actually verifies — plan-time counting would
+                # inflate the acceptance-rate denominator with
+                # proposals that preemption or a failed dispatch
+                # dropped before any verification could accept them
+                self._num_draft_tokens += int(dlens.sum())
             self._pending = (toks, list(active))
             return
 
@@ -1032,16 +1286,57 @@ class InferenceEngine:
         # each lane's emitted tokens are its non-sentinel prefix (lanes
         # freeze permanently mid-scan, and real token ids are >= 0)
         counts = (toks >= 0).sum(axis=1)
+        spec = self.config.spec_tokens > 0
+        bs = self.config.block_size
         for i in active:
             slot = self.slots[i]
-            for j in range(int(counts[i])):
+            n = int(counts[i])
+            for j in range(n):
                 slot.tokens.append(slot.last_token)   # its K/V landed
                 slot.context_len += 1
                 self._register_full_blocks(slot)
                 self._record_token(i, int(toks[i, j]))
                 if self.slots[i] is None:
                     break
-            self._num_tokens_decoded += int(counts[i])
+            self._num_tokens_decoded += n
+            if not spec:
+                continue
+            # speculative bookkeeping: an emitted token that matches
+            # the lane's proposal at its index IS an accepted draft
+            # (the correction is drawn with the draft masked out and a
+            # greedy rejection means argmax != draft, so a match can
+            # only be an acceptance; the bonus sits past the plan)
+            prop = self._draft_plan.get(i, ())
+            for j in range(min(n, len(prop))):
+                if int(toks[i, j]) != prop[j]:
+                    break
+                self._num_accepted_tokens += 1
+            # reservation rollback: the span was reserved for EVERY
+            # proposal's write, but rejection advanced the context by
+            # less — blocks holding only unaccepted K/V go back to the
+            # pool now instead of idling on the slot (the K/V itself
+            # needs no rollback: it sits past the context length every
+            # attention mask already excludes)
+            slot = self.slots[i]
+            if slot is not None:
+                keep = blocks_needed(slot.context_len, bs)
+                if len(slot.blocks) > keep:
+                    trimmed = len(slot.blocks) - keep
+                    slot.blocks = self.allocator.trim_to(slot.blocks,
+                                                         keep)
+                    self._num_spec_blocks_rolled_back += trimmed
+                    # deliberately NO table invalidation: the trimmed
+                    # entries sit past blocks_needed(context_len), so
+                    # every gather of them is position-masked, and any
+                    # future span reaching that region must first
+                    # allocate (need > len(blocks)) — which invalidates
+                    # and rebuilds. Skipping it here keeps the device
+                    # mirror warm in the low-acceptance regime, where
+                    # trim would otherwise force a rebuild every tick.
+                    # (Eager reclaim itself is load-bearing: held
+                    # reservations would let a low-acceptance engine
+                    # squat on spec_tokens-worth of blocks per lane,
+                    # changing admission/preemption under tight pools.)
         return True
 
     def step(self) -> bool:
@@ -1091,6 +1386,10 @@ class InferenceEngine:
         pre_quarantine = self._num_quarantines
         active = [i for i, s in enumerate(self.slots)
                   if s is not None and s.started]
+        if active and self.config.spec_tokens > 0:
+            # proposals first: the span reservation below is sized by
+            # each lane's proposal count
+            self._build_draft_plan(active)
         if active:
             self._ensure_decode_blocks()
             # preemption may have cleared lanes — re-collect
@@ -1212,6 +1511,12 @@ class InferenceEngine:
                          for uid, toks in self.finished.items()},
             "statuses": dict(self.statuses),
             "counters": self.stats(),
+            # behavioral, not audit: a quarantined drafter must STAY
+            # quarantined across restore — resumed speculation would
+            # draw accept/resample uniforms the uninterrupted
+            # (empty-plan) run never drew, breaking sampled-lane
+            # restore bit-identity
+            "drafter_ok": bool(self._drafter_ok),
             "block_tables": {
                 self.slots[i].request.uid: [int(b) for b in
                                             self.slots[i].blocks]
@@ -1265,6 +1570,14 @@ class InferenceEngine:
         self.finished.update({uid: [int(t) for t in toks]
                               for uid, toks in snap["finished"].items()})
         self.statuses.update(snap["statuses"])
+        # drafter-quarantine state is behavioral (see snapshot): a
+        # pre-quarantine snapshot restores with speculation live, a
+        # post-quarantine one stays degraded — either way the restored
+        # token stream matches the uninterrupted run. The drafter
+        # OBJECT itself is the caller's contract, like params: restore
+        # with an equivalent (pure-function-of-history) drafter.
+        self._drafter_ok = (bool(snap["drafter_ok"])
+                            and self.config.spec_tokens > 0)
         self._num_restores += 1
 
     def check_allocator_integrity(self) -> None:
@@ -1320,4 +1633,19 @@ class InferenceEngine:
             "num_quarantines": self._num_quarantines,
             "num_snapshots": self._num_snapshots,
             "num_restores": self._num_restores,
+            # speculative decoding (docs/serving.md): proposed vs
+            # accepted draft tokens — the acceptance rate is THE
+            # speculation health metric (tokens per target forward =
+            # 1 + rate * spec_tokens, roughly); speculation_active
+            # drops to 0 when a crashing drafter was quarantined
+            "num_draft_tokens": self._num_draft_tokens,
+            "num_accepted_tokens": self._num_accepted_tokens,
+            "draft_acceptance_rate": (
+                self._num_accepted_tokens / self._num_draft_tokens
+                if self._num_draft_tokens else 0.0),
+            "num_draft_retries": self._num_draft_retries,
+            "num_drafter_quarantines": self._num_drafter_quarantines,
+            "num_spec_blocks_rolled_back":
+                self._num_spec_blocks_rolled_back,
+            "speculation_active": int(self._drafter_ok),
         }

@@ -287,6 +287,40 @@ class BlockAllocator:
         self.acquire(out)
         return out
 
+    def trim_to(self, blocks: Sequence[int], keep: int) -> List[int]:
+        """Release the tail of a sequence's block list past its first
+        ``keep`` entries and return the kept prefix as a new list — the
+        **speculative-reservation rollback**: the engine reserves
+        blocks for a verify span's worst case (every draft written),
+        and when rejection leaves the sequence short of the span, the
+        blocks holding only unaccepted positions go back to the pool
+        here instead of idling on the slot until the request finishes.
+
+        Safety contract, enforced: a trimmed block must be PRIVATE
+        (refcount exactly 1) and UNREGISTERED — a shared or
+        prefix-indexed block holds context some sequence (or the cache
+        index) still reaches, and trimming it would be a use-after-free
+        of live K/V. Violations raise ``ValueError`` before anything is
+        released. The tail is freed deepest-first, matching the other
+        release paths."""
+        blocks = [int(b) for b in blocks]
+        keep = int(keep)
+        if not 0 <= keep <= len(blocks):
+            raise ValueError(
+                f"keep must be in [0, {len(blocks)}], got {keep}")
+        tail = blocks[keep:]
+        for b in tail:
+            if self._ref.get(b, 0) != 1:
+                raise ValueError(
+                    f"cannot trim block {b}: refcount "
+                    f"{self._ref.get(b, 0)} != 1 (shared or not owned)")
+            if b in self._block_to_hash:
+                raise ValueError(
+                    f"cannot trim block {b}: registered in the prefix "
+                    "index (it is matchable cached context)")
+        self.free(list(reversed(tail)))
+        return blocks[:keep]
+
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._ref.clear()

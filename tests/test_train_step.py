@@ -539,18 +539,28 @@ def test_bench_smoke_mode_every_section_rc0():
         "ddp_syncbn_allreduce_bytes_over_grad_bytes_8dev",
         "serving_tiny_smoke_decode_steps_per_sec",
         "serving_tiny_smoke_multistep_decode_tokens_per_sec",
+        "serving_tiny_speculative_decode_tokens_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
     }
     for r in records:
         if "metric" in r:
             assert "value" in r and "vs_baseline" in r, r["metric"]
+    # the speculative arm must actually speculate in smoke shape: a
+    # zero acceptance count would mean the drafter is silently off and
+    # the record a quiet perf lie
+    spec = [r for r in records
+            if r.get("metric") == "serving_tiny_speculative_decode_tokens_per_sec"][0]
+    assert spec["acceptance_rate"] > 0, spec
+    assert spec["arms"]["speculative"]["num_accepted_tokens"] > 0, spec
+    assert spec["outputs_bit_identical"] is True, spec
     # every section also leaves a wall-time/exit-status record, so a
     # section that dies is a visible "failed" entry in the artifact,
     # never just an absence
     sections = {r["section"]: r for r in records if "section" in r}
     assert set(sections) == {
         "bench_layer_norm", "bench_fused_lamb", "bench_ddp_scaling",
-        "bench_serving", "bench_serving_multistep", "bench_train_step",
+        "bench_serving", "bench_serving_multistep",
+        "bench_serving_speculative", "bench_train_step",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec
