@@ -19,6 +19,7 @@ from apex_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
     EngineStalledError,
     InferenceEngine,
+    QueueFullError,
     Request,
     RequestResult,
 )

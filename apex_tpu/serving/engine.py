@@ -116,6 +116,24 @@ under prefix caching) and — because sampling is schedule-invariant —
 continues bit-identically to the uninterrupted run. ``run()`` raises a
 diagnostic :class:`EngineStalledError` instead of spinning if a full
 step ever makes no progress while work remains.
+
+**Overload protection** (docs/robustness.md): faults are one failure
+mode; too much *legitimate* traffic is the other. The waiting queue is
+bounded (``max_waiting``; ``add_request`` raises
+:class:`QueueFullError`, ``try_add`` returns ``False`` — explicit
+backpressure instead of unbounded memory growth), requests carry an
+integer ``priority`` class (0 = most urgent; admission and preemption
+order by ``(priority, age)``, and uniform-priority traffic schedules
+bit-identically to the pre-priority FIFO), an **admit-time feasibility
+gate** sheds requests whose deadline cannot cover even a
+contention-free service estimate (status ``"rejected"``, fed by cheap
+EWMAs of observed per-dispatch wall time) before they burn pool blocks
+they would time out of, and a **degradation ladder** steps the engine
+down deterministically under sustained pressure (free-block /
+queue-depth watermarks with hysteresis): suspend speculative decoding,
+flush the prefix cache aggressively, pause admission of the lowest
+priority class — and back up when pressure clears, every transition
+counted in ``stats()`` and serialized through snapshot/restore.
 """
 
 from __future__ import annotations
@@ -154,6 +172,14 @@ from apex_tpu.serving.sampling import (
     spec_verify_tokens,
 )
 
+# new-observation weight of the per-dispatch wall-time EWMAs feeding
+# the admit-time feasibility gate
+_EWMA_ALPHA = 0.25
+# degradation-ladder rungs (cumulative): 1 = speculation suspended,
+# 2 = + prefix cache flushed every tick, 3 = + lowest-class admission
+# paused
+_LADDER_TOP = 3
+
 
 @dataclasses.dataclass(frozen=True)
 class Request:
@@ -173,10 +199,18 @@ class Request:
     # Wall-clock TTL in seconds from add_request, measured against the
     # engine's clock (injectable for tests). None = no deadline.
     deadline_s: Optional[float] = None
-    # Terminal lifecycle status — "finished" | "timeout" | "failed" —
-    # written by the engine via object.__setattr__ when the request
-    # leaves it (the one engine-owned field of the frozen request);
-    # None while waiting/active. Excluded from equality/hash.
+    # Priority class, 0 = most urgent. Admission considers classes in
+    # ascending value (FIFO within a class) and preemption/quarantine
+    # yield the lowest class first (then youngest). A pure SCHEDULING
+    # knob: sampling is arrival-keyed, so per-request outputs are
+    # identical under any priority assignment (tested), and
+    # uniform-priority traffic is bit-identical to the pre-priority
+    # FIFO scheduler.
+    priority: int = 0
+    # Terminal lifecycle status — "finished" | "timeout" | "failed" |
+    # "rejected" — written by the engine via object.__setattr__ when
+    # the request leaves it (the one engine-owned field of the frozen
+    # request); None while waiting/active. Excluded from equality/hash.
     status: Optional[str] = dataclasses.field(default=None, compare=False)
 
 
@@ -185,11 +219,19 @@ class RequestResult:
     """One entry of ``run(return_status=True)``: the generated tokens
     plus the request's terminal status (the result contract in
     docs/serving.md). ``tokens`` may be shorter than ``max_new_tokens``
-    for ``"timeout"``/``"failed"`` exits — everything emitted before
-    the cut is preserved."""
+    for ``"timeout"``/``"failed"``/``"rejected"`` exits — everything
+    emitted before the cut is preserved."""
 
     tokens: List[int]
     status: str
+
+
+class QueueFullError(RuntimeError):
+    """``add_request`` refused: the waiting queue already holds
+    ``EngineConfig.max_waiting`` entries. The explicit backpressure
+    signal — callers shed, retry later, or route to another replica
+    instead of growing an unbounded queue that will only manufacture
+    timeouts. ``try_add`` is the non-raising variant."""
 
 
 class EngineStalledError(RuntimeError):
@@ -257,6 +299,30 @@ class EngineConfig:
     # decode_steps is ignored while speculation is on: the verify
     # forward IS the dispatch, there is no scan to fuse.
     spec_tokens: int = 0
+    # -- overload protection (docs/robustness.md) ----------------------
+    # Bound on the waiting queue: add_request past it raises
+    # QueueFullError (try_add returns False) — explicit backpressure
+    # instead of unbounded memory growth. None = unbounded (the
+    # pre-overload behavior). Preemption/recovery requeues of already-
+    # resident requests bypass the bound (at most max_batch extra).
+    max_waiting: Optional[int] = None
+    # Degradation-ladder watermarks: pressure is queue depth >=
+    # queue_high_watermark OR allocatable fraction ((num_free +
+    # num_cached) / num_blocks — evictable counts as headroom, or a
+    # warm prefix cache would read as overload and sawtooth the
+    # ladder) <= free_block_low_watermark. After degrade_patience
+    # CONSECUTIVE
+    # pressure ticks the engine steps one rung down; after the same
+    # number of consecutive clear ticks, one rung up (the hysteresis).
+    # Rungs, cumulative: 1 = suspend speculative decoding, 2 = flush
+    # the prefix cache every tick, 3 = pause admission of priority
+    # classes >= degrade_admit_priority (unless the engine is otherwise
+    # idle — an idle engine serves whatever it has). Both watermarks
+    # None = ladder off (default).
+    queue_high_watermark: Optional[int] = None
+    free_block_low_watermark: Optional[float] = None
+    degrade_patience: int = 2
+    degrade_admit_priority: int = 1
     seed: int = 0
 
     def __post_init__(self):
@@ -286,6 +352,39 @@ class EngineConfig:
             raise ValueError(
                 f"max_dispatch_retries must be >= 0, got "
                 f"{self.max_dispatch_retries}")
+        if self.max_waiting is not None and self.max_waiting < 1:
+            raise ValueError(
+                f"max_waiting must be >= 1 (or None for unbounded), "
+                f"got {self.max_waiting}")
+        if (self.queue_high_watermark is not None
+                and self.queue_high_watermark < 1):
+            raise ValueError(
+                f"queue_high_watermark must be >= 1, got "
+                f"{self.queue_high_watermark}")
+        if (self.queue_high_watermark is not None
+                and self.max_waiting is not None
+                and self.queue_high_watermark
+                > self.max_waiting + self.max_batch):
+            # client adds cap the queue at max_waiting and requeues
+            # overshoot by at most max_batch: a higher watermark is
+            # unreachable and the ladder's queue signal silently inert
+            raise ValueError(
+                f"queue_high_watermark ({self.queue_high_watermark}) is "
+                f"unreachable: the queue never exceeds max_waiting + "
+                f"max_batch ({self.max_waiting} + {self.max_batch})")
+        if (self.free_block_low_watermark is not None
+                and not 0.0 < self.free_block_low_watermark <= 1.0):
+            raise ValueError(
+                f"free_block_low_watermark must be in (0, 1], got "
+                f"{self.free_block_low_watermark}")
+        if self.degrade_patience < 1:
+            raise ValueError(
+                f"degrade_patience must be >= 1, got "
+                f"{self.degrade_patience}")
+        if self.degrade_admit_priority < 1:
+            raise ValueError(
+                f"degrade_admit_priority must be >= 1 (0 would pause "
+                f"every class), got {self.degrade_admit_priority}")
 
 
 @dataclasses.dataclass
@@ -299,12 +398,93 @@ class _QueueEntry:
     resumed request continues the SAME key sequence at the next token
     index). ``hashes`` memoizes the prefill sequence's block hash chain
     (the sequence is frozen per entry), so a head blocked on pool
-    pressure is not re-hashed on every scheduler tick."""
+    pressure is not re-hashed on every scheduler tick. ``enq_t`` /
+    ``enq_tick`` stamp when the entry (re-)entered the queue — the
+    queue-wait observability in ``stats()`` (a preempted requeue
+    restarts the wait)."""
 
     request: Request
     arrival: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     hashes: Optional[List[str]] = None
+    enq_t: float = 0.0
+    enq_tick: int = 0
+
+
+class _WaitingQueue:
+    """The waiting queue, priority-aware: one FIFO :class:`deque` per
+    priority class, scanned in ascending class value (0 = most urgent).
+    ``append`` enqueues at the tail of the request's class,
+    ``appendleft`` (preemption / crash-recovery requeues) at its head —
+    exactly the old single-deque discipline per class, so
+    uniform-priority traffic degenerates to the pre-priority FIFO
+    bit-for-bit. Iteration order is admission order (class by class,
+    FIFO within), which is also the snapshot serialization order."""
+
+    def __init__(self):
+        self._classes: Dict[int, deque] = {}
+
+    def _first_class(self, below: Optional[int] = None) -> Optional[int]:
+        # every deque in _classes is non-empty (dead classes are
+        # deleted the moment they drain), so this is a pure key scan
+        return min((p for p in self._classes
+                    if below is None or p < below), default=None)
+
+    def append(self, entry: _QueueEntry) -> None:
+        self._classes.setdefault(entry.request.priority,
+                                 deque()).append(entry)
+
+    def appendleft(self, entry: _QueueEntry) -> None:
+        self._classes.setdefault(entry.request.priority,
+                                 deque()).appendleft(entry)
+
+    def head(self, below: Optional[int] = None) -> Optional[_QueueEntry]:
+        """The next admissible entry — most urgent class's front, or
+        None. ``below`` restricts to classes < it (the ladder's
+        admission pause)."""
+        p = self._first_class(below)
+        return None if p is None else self._classes[p][0]
+
+    def popleft(self, below: Optional[int] = None) -> _QueueEntry:
+        """Pop exactly the entry :meth:`head` (same ``below``) returns."""
+        p = self._first_class(below)
+        if p is None:
+            raise IndexError("pop from an empty waiting queue")
+        entry = self._classes[p].popleft()
+        if not self._classes[p]:
+            # drop drained classes: priority is an arbitrary client
+            # int, and dead deques would make every head()/popleft()
+            # scan (and the dict itself) grow with every distinct
+            # value ever submitted
+            del self._classes[p]
+        return entry
+
+    def has_priority_below(self, limit: int) -> bool:
+        return self._first_class(below=limit) is not None
+
+    def expel(self, pred) -> List[_QueueEntry]:
+        """Remove (and return, in admission order) every entry matching
+        ``pred``, preserving the order of the survivors — the deadline
+        expiry sweep."""
+        removed: List[_QueueEntry] = []
+        for p in sorted(self._classes):
+            q = self._classes[p]
+            kept: deque = deque()
+            while q:
+                e = q.popleft()
+                (removed if pred(e) else kept).append(e)
+            if kept:
+                self._classes[p] = kept
+            else:
+                del self._classes[p]    # same dead-class hygiene
+        return removed
+
+    def __iter__(self):
+        for p in sorted(self._classes):
+            yield from self._classes[p]
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._classes.values())
 
 
 @dataclasses.dataclass
@@ -403,7 +583,11 @@ class InferenceEngine:
             dtype=config.kv_dtype)
         self.allocator = BlockAllocator(config.num_blocks)
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
-        self.waiting: deque = deque()
+        self.waiting = _WaitingQueue()
+        # every uid currently waiting or resident — the O(1) backing of
+        # add_request's duplicate guard (maintained at enqueue/restore,
+        # cleared by _set_status at every terminal transition)
+        self._live_uids: set = set()
         self.finished: Dict[str, List[int]] = {}
         # terminal status per finished uid ("finished"|"timeout"|"failed");
         # drained alongside `finished` by run()
@@ -431,6 +615,30 @@ class InferenceEngine:
         self._num_spec_blocks_rolled_back = 0
         self._num_snapshots = 0
         self._num_restores = 0
+        # -- overload protection (docs/robustness.md) ------------------
+        self._num_ticks = 0
+        self._queue_depth_peak = 0
+        self._queue_wait_count = 0
+        self._queue_wait_ticks_sum = 0
+        self._queue_wait_ticks_max = 0
+        self._queue_wait_s_sum = 0.0
+        self._queue_wait_s_max = 0.0
+        self._num_rejected_queue_full = 0
+        self._num_rejected_infeasible = 0
+        # cheap service-time estimators feeding the admit-time
+        # feasibility gate: EWMAs of observed per-dispatch wall time
+        # (None until the first observation — the gate stays open)
+        self._ewma_prefill_s: Optional[float] = None
+        self._ewma_decode_s: Optional[float] = None
+        # the degradation ladder: current rung (0 = normal), the
+        # pressure/clear streaks driving its hysteresis, and the
+        # transition counters
+        self._degradation_level = 0
+        self._pressure_streak = 0
+        self._clear_streak = 0
+        self._num_degrade_steps_down = 0
+        self._num_degrade_steps_up = 0
+        self._num_degrade_flushed_blocks = 0
         self._fetch_failures = 0   # consecutive failed deferred drains
         # the in-flight decode dispatch: (device [B, K] tokens, device
         # [B] counts, the lane indices it covers). Fetched — the only
@@ -607,15 +815,63 @@ class InferenceEngine:
             raise ValueError(
                 f"request {request.uid!r}: deadline_s must be positive "
                 f"(got {request.deadline_s})")
+        if request.priority < 0:
+            raise ValueError(
+                f"request {request.uid!r}: priority must be >= 0 "
+                f"(got {request.priority}); 0 is the most urgent class")
         request.sampling.validate()
+        # a uid that is still waiting or resident would collide in the
+        # uid-keyed deadline map and the engine-owned status field —
+        # and a terminal-but-undrained uid would have its result in
+        # finished/statuses silently CLOBBERED by the new lifecycle's
+        # exit. Reject both loudly; a DRAINED uid starts a fresh
+        # lifecycle as before.
+        uid = request.uid
+        if uid in self._live_uids:
+            raise ValueError(
+                f"request uid {uid!r} is already waiting or resident in "
+                "this engine; drain it (run()) or pick a distinct uid")
+        if uid in self.statuses:
+            raise ValueError(
+                f"request uid {uid!r} has a terminal result "
+                f"({self.statuses[uid]!r}) awaiting drain; run() before "
+                "reusing the uid, or pick a distinct one")
         # the engine owns the terminal-status field from here on (a
-        # re-submitted request object starts a fresh lifecycle)
+        # re-submitted request object starts a fresh lifecycle) —
+        # cleared BEFORE the queue-full shed, so a door-shed request
+        # reads status None, not a stale verdict from its previous
+        # lifecycle (the documented "no status" contract)
         object.__setattr__(request, "status", None)
+        # backpressure: the bounded queue is the overload contract —
+        # callers get an explicit shed signal, not unbounded growth
+        if (self.config.max_waiting is not None
+                and len(self.waiting) >= self.config.max_waiting):
+            self._num_rejected_queue_full += 1
+            raise QueueFullError(
+                f"request {uid!r} rejected: waiting queue is at "
+                f"max_waiting ({self.config.max_waiting})")
+        self._live_uids.add(uid)
         if request.deadline_s is not None:
             self._deadline[request.uid] = self._clock() + request.deadline_s
         self.waiting.append(_QueueEntry(request=request,
-                                        arrival=self._arrival_count))
+                                        arrival=self._arrival_count,
+                                        enq_t=self._clock(),
+                                        enq_tick=self._num_ticks))
         self._arrival_count += 1
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.waiting))
+
+    def try_add(self, request: Request) -> bool:
+        """Non-raising backpressure variant of :meth:`add_request`:
+        returns False when the bounded queue sheds the request (and
+        counts it), True when enqueued. Validation errors — bad
+        geometry, duplicate uid — still raise: those are caller bugs,
+        not load."""
+        try:
+            self.add_request(request)
+        except QueueFullError:
+            return False
+        return True
 
     def _request_key(self, entry: _QueueEntry):
         """The request's own PRNG key: engine seed x arrival order.
@@ -687,10 +943,23 @@ class InferenceEngine:
 
     def _set_status(self, request: Request, status: str) -> None:
         """Record a terminal status: in the drain-able ``statuses`` map,
-        on the request object itself, and out of the deadline watch."""
+        on the request object itself, and out of the deadline watch and
+        the live-uid set (every terminal transition funnels through
+        here — the uid is re-usable from this point)."""
         self.statuses[request.uid] = status
         object.__setattr__(request, "status", status)
         self._deadline.pop(request.uid, None)
+        self._live_uids.discard(request.uid)
+
+    def _yield_key(self, idx: int):
+        """Victim-selection order for preemption and decode quarantine-
+        by-elimination: the LOWEST priority class first (largest class
+        value), then the youngest (largest ``admit_seq``) — ``max()``
+        over this key picks the victim, so a victim's class is always
+        >= every survivor's. Uniform-priority traffic reduces exactly
+        to the pre-priority youngest-first rule."""
+        slot = self.slots[idx]
+        return (slot.request.priority, slot.admit_seq, idx)
 
     @staticmethod
     def _resume_tokens(slot: "_Slot") -> List[int]:
@@ -737,25 +1006,23 @@ class InferenceEngine:
         if not self._deadline:
             return 0
         now = self._clock()
+        # O(#deadlines) pre-check: only rebuild the queue's deques when
+        # something actually expired (the common tick touches nothing)
+        due = {uid for uid, dl in self._deadline.items() if now >= dl}
+        if not due:
+            return 0
         expired = 0
         if self.waiting:
-            kept: deque = deque()
-            while self.waiting:
-                entry = self.waiting.popleft()
-                dl = self._deadline.get(entry.request.uid)
-                if dl is not None and now >= dl:
-                    self.finished[entry.request.uid] = list(entry.generated)
-                    self._set_status(entry.request, "timeout")
-                    self._num_timeouts += 1
-                    expired += 1
-                else:
-                    kept.append(entry)
-            self.waiting = kept
+            for entry in self.waiting.expel(
+                    lambda e: e.request.uid in due):
+                self.finished[entry.request.uid] = list(entry.generated)
+                self._set_status(entry.request, "timeout")
+                self._num_timeouts += 1
+                expired += 1
         for i, slot in enumerate(self.slots):
             if slot is None or (slot.started and not include_started):
                 continue
-            dl = self._deadline.get(slot.request.uid)
-            if dl is not None and now >= dl:
+            if slot.request.uid in due:
                 self._finish(i, status="timeout")
                 self._num_timeouts += 1
                 expired += 1
@@ -777,8 +1044,15 @@ class InferenceEngine:
             slot = self.slots[i]
             self.waiting.appendleft(_QueueEntry(
                 request=slot.request, arrival=slot.entry.arrival,
-                generated=self._resume_tokens(slot)))
+                generated=self._resume_tokens(slot),
+                enq_t=self._clock(), enq_tick=self._num_ticks))
             self.slots[i] = None
+        # requeues are the one path that pushes the queue past
+        # max_waiting (by at most max_batch) — the exact overshoot the
+        # peak metric exists to expose, sampled here before admission
+        # can re-absorb it
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.waiting))
         self.allocator.reset()
         self.cache = jax.tree.map(jnp.zeros_like, self.cache)
         self._draft_plan = {}   # its lanes no longer exist
@@ -804,6 +1078,14 @@ class InferenceEngine:
             retries=self.config.max_dispatch_retries,
             backoff_s=self.config.retry_backoff_s, on_retry=count)
         return out
+
+    @staticmethod
+    def _ewma_update(prev: Optional[float], dt: float) -> float:
+        """The feasibility gate's service-time estimator: first
+        observation seeds it, later ones blend at ``_EWMA_ALPHA``."""
+        dt = max(0.0, float(dt))
+        return dt if prev is None else (1.0 - _EWMA_ALPHA) * prev \
+            + _EWMA_ALPHA * dt
 
     def _record_token(self, idx: int, token: int) -> None:
         """Append a sampled token to a slot, finishing on EOS/max-len."""
@@ -844,6 +1126,94 @@ class InferenceEngine:
 
     # -- admission (optimistic: current need, not worst case) --------------
 
+    def _admission_priority_limit(self) -> Optional[int]:
+        """The ladder's admission pause (rung 3): classes >=
+        ``degrade_admit_priority`` are held in the queue while the
+        engine sheds load — UNLESS nothing more urgent exists anywhere
+        (no resident lane, no admissible higher-class entry): an
+        otherwise-idle engine serves whatever it has (work
+        conservation; without it, a queue holding only paused classes
+        would deadlock against the stall guard)."""
+        if self._degradation_level < 3:
+            return None
+        limit = self.config.degrade_admit_priority
+        if (any(s is not None for s in self.slots)
+                or self.waiting.has_priority_below(limit)):
+            return limit
+        return None
+
+    def _estimate_service_s(self, prompt_tail: int, remaining: int,
+                            skips_prefill: bool = False) -> Optional[float]:
+        """Contention-free service-time estimate for the feasibility
+        gate: uncached-prompt chunks at the prefill EWMA plus
+        ``ceil(remaining / K)`` decode dispatches at the decode EWMA —
+        a LOWER bound on serving the request's FULL ``max_new_tokens``
+        budget (it assumes an idle engine), so the gate only sheds
+        requests whose committed demand could not meet the deadline
+        even alone. The budget is the demand the gate prices: a
+        request counting on an early EOS to beat its deadline should
+        ask for fewer tokens (the engine cannot know where EOS falls).
+        None (gate open) until at least one dispatch was observed.
+        A zero tail still costs one chunk — a fresh fully-cached prompt
+        runs one write-suppressed pass for its logits — EXCEPT when the
+        caller knows the entry skips prefill entirely (a resumed entry
+        whose whole history is cached goes straight to decode)."""
+        pf, dc = self._ewma_prefill_s, self._ewma_decode_s
+        if pf is None and dc is None:
+            return None
+        if skips_prefill and prompt_tail <= 0:
+            chunks = 0
+        else:
+            chunks = max(1, -(-max(prompt_tail, 0) // self._chunk))
+        # speculating, a dispatch GUARANTEES only one token (every
+        # proposal may be rejected) — the conservative per-dispatch
+        # floor, like the scan's K
+        per = 1 if self.config.spec_tokens > 0 else self.config.decode_steps
+        dispatches = -(-max(remaining, 0) // per)
+        return chunks * (pf or 0.0) + dispatches * (dc or 0.0)
+
+    def _shed_if_infeasible(self, entry: _QueueEntry,
+                            uncached_tail: int,
+                            below: Optional[int]) -> bool:
+        """The admit-time feasibility gate: a deadline that cannot
+        cover even the contention-free service estimate is shed NOW,
+        with status ``"rejected"`` — before the request burns pool
+        blocks and prefill compute it is guaranteed to time out of.
+        Tokens a preempted entry already carries are preserved."""
+        req = entry.request
+        dl = self._deadline.get(req.uid)
+        if dl is None:
+            return False
+        remaining = req.max_new_tokens - len(entry.generated)
+        if not entry.generated:
+            # fresh entry: the FINAL prefill chunk emits the first
+            # generated token (_record_token in the prefill tick), so
+            # decode owes one fewer — a resumed entry's re-prefill
+            # emits nothing new (its tokens ride the queue entry)
+            remaining -= 1
+        est = self._estimate_service_s(
+            uncached_tail, remaining,
+            # a resumed entry whose whole history is cached skips
+            # prefill entirely (_admit starts it decoding directly)
+            skips_prefill=bool(entry.generated) and uncached_tail <= 0)
+        if est is None or self._clock() + est <= dl:
+            return False
+        self.waiting.popleft(below=below)    # exactly this entry
+        self.finished[req.uid] = list(entry.generated)
+        self._set_status(req, "rejected")
+        self._num_rejected_infeasible += 1
+        return True
+
+    def _note_admitted_wait(self, entry: _QueueEntry) -> None:
+        wait_ticks = self._num_ticks - entry.enq_tick
+        wait_s = max(0.0, self._clock() - entry.enq_t)
+        self._queue_wait_count += 1
+        self._queue_wait_ticks_sum += wait_ticks
+        self._queue_wait_ticks_max = max(self._queue_wait_ticks_max,
+                                         wait_ticks)
+        self._queue_wait_s_sum += wait_s
+        self._queue_wait_s_max = max(self._queue_wait_s_max, wait_s)
+
     def _admit(self) -> int:
         """Move waiting requests into free lanes while the pool can
         cover their CURRENT need — the uncached prompt-tail blocks plus
@@ -852,61 +1222,81 @@ class InferenceEngine:
         ``max_new_tokens``; over-commit is safe now that decode-time
         exhaustion preempts instead of aborting). Prefix caching makes
         the need smaller still: the longest cached block-aligned prefix
-        is shared by reference, and only the tail is prefilled."""
+        is shared by reference, and only the tail is prefilled.
+
+        Candidates are considered in ``(priority, arrival)`` order
+        (:class:`_WaitingQueue`); an infeasible-deadline head is shed
+        by the gate and the next candidate considered, while a head
+        that merely does not FIT blocks everything behind it
+        (head-of-line blocking — no starvation WITHIN a class; across
+        classes the strict priority order is the design: sustained
+        higher-class load starves lower classes, bounded only by their
+        deadlines)."""
         bs = self.config.block_size
         admitted = 0
+        below = self._admission_priority_limit()
         for idx in range(self.config.max_batch):
-            if not self.waiting or self.slots[idx] is not None:
+            if self.slots[idx] is not None:
                 continue
-            entry = self.waiting[0]
-            seq = list(entry.request.prompt)
-            if entry.generated:
-                seq += entry.generated[:-1]   # resume: re-cache history
-            L = len(seq)
-            matched: List[int] = []
-            hashes: List[str] = []
-            if self.config.enable_prefix_caching:
-                if entry.hashes is None:
-                    entry.hashes = self._seq_hashes(seq)
-                hashes = entry.hashes
-                matched = self.allocator.lookup_prefix(hashes)
-            tail = blocks_needed(L, bs) - len(matched)
-            # current need = blocks through the FIRST decode write
-            # (position L): blocks_needed(L + 1). That is tail + 1 only
-            # when the prompt exactly fills its blocks — an exact-fit
-            # request whose whole generation lives in the last partial
-            # block needs no headroom at all
-            need = blocks_needed(L + 1, bs) - len(matched)
-            # matched blocks that are currently cached (refcount 0)
-            # stop being evictable once we take them, so they don't
-            # count toward the capacity the tail can draw from
-            reviving = sum(1 for b in matched
-                           if self.allocator.refcount(b) == 0)
-            if (need > self.allocator.num_free
-                    + self.allocator.num_cached - reviving):
-                break   # FIFO: don't let a small request starve the head
-            self.allocator.acquire(matched)
-            self.waiting.popleft()
-            blocks = matched + (self.allocator.alloc(tail) if tail else [])
-            m_tok = len(matched) * bs
-            self._prefix_lookup_blocks += len(hashes)
-            self._prefix_hit_blocks += len(matched)
-            self._prompt_blocks_allocated += tail
-            self._admit_count += 1
-            slot = _Slot(entry=entry, admit_seq=self._admit_count,
-                         tokens=seq, prefill_len=L, prefill_pos=m_tok,
-                         context_len=m_tok, blocks=blocks,
-                         block_hashes=list(hashes),
-                         num_registered=len(matched), generated=[],
-                         last_token=0, started=False)
-            if entry.generated and m_tok == L:
-                # resumed and fully cached: nothing to recompute at all
-                slot.generated = list(entry.generated)
-                slot.last_token = slot.generated[-1]
-                slot.started = True
-            self.slots[idx] = slot
-            self._invalidate_lanes()
-            admitted += 1
+            while True:
+                entry = self.waiting.head(below=below)
+                if entry is None:
+                    return admitted
+                seq = list(entry.request.prompt)
+                if entry.generated:
+                    seq += entry.generated[:-1]   # resume: re-cache history
+                L = len(seq)
+                matched: List[int] = []
+                hashes: List[str] = []
+                if self.config.enable_prefix_caching:
+                    if entry.hashes is None:
+                        entry.hashes = self._seq_hashes(seq)
+                    hashes = entry.hashes
+                    matched = self.allocator.lookup_prefix(hashes)
+                m_tok = len(matched) * bs
+                if self._shed_if_infeasible(entry, L - m_tok, below):
+                    continue    # gate shed the head; try the next one
+                tail = blocks_needed(L, bs) - len(matched)
+                # current need = blocks through the FIRST decode write
+                # (position L): blocks_needed(L + 1). That is tail + 1
+                # only when the prompt exactly fills its blocks — an
+                # exact-fit request whose whole generation lives in the
+                # last partial block needs no headroom at all
+                need = blocks_needed(L + 1, bs) - len(matched)
+                # matched blocks that are currently cached (refcount 0)
+                # stop being evictable once we take them, so they don't
+                # count toward the capacity the tail can draw from
+                reviving = sum(1 for b in matched
+                               if self.allocator.refcount(b) == 0)
+                if (need > self.allocator.num_free
+                        + self.allocator.num_cached - reviving):
+                    # head-of-line blocking: don't let a small request
+                    # starve the head
+                    return admitted
+                self.allocator.acquire(matched)
+                self.waiting.popleft(below=below)
+                self._note_admitted_wait(entry)
+                blocks = matched + (self.allocator.alloc(tail)
+                                    if tail else [])
+                self._prefix_lookup_blocks += len(hashes)
+                self._prefix_hit_blocks += len(matched)
+                self._prompt_blocks_allocated += tail
+                self._admit_count += 1
+                slot = _Slot(entry=entry, admit_seq=self._admit_count,
+                             tokens=seq, prefill_len=L, prefill_pos=m_tok,
+                             context_len=m_tok, blocks=blocks,
+                             block_hashes=list(hashes),
+                             num_registered=len(matched), generated=[],
+                             last_token=0, started=False)
+                if entry.generated and m_tok == L:
+                    # resumed and fully cached: nothing to recompute
+                    slot.generated = list(entry.generated)
+                    slot.last_token = slot.generated[-1]
+                    slot.started = True
+                self.slots[idx] = slot
+                self._invalidate_lanes()
+                admitted += 1
+                break
         return admitted
 
     # -- chunked prefill ---------------------------------------------------
@@ -938,6 +1328,13 @@ class InferenceEngine:
         table[0, : len(slot.blocks)] = slot.blocks
         temp, top_k, top_p = self._sampling_arrays([slot.request.sampling])
 
+        # the EWMA times the attempt BODY only (set by the successful
+        # attempt): retry backoff sleeps are failure handling, not
+        # service time, and folding them in would inflate the
+        # feasibility gate's contention-free lower bound into
+        # over-shedding after one transient fault
+        attempt_s = [0.0]
+
         def attempt():
             # dispatch AND fetch inside the retry unit — EVERY chunk,
             # deliberately paying one host sync per chunk: prefill's
@@ -954,6 +1351,7 @@ class InferenceEngine:
             # the drain-failure reset; the per-chunk sync is the price
             # of exact fault isolation, amortized over C tokens of
             # forward compute
+            t0 = self._clock()
             cache, tok = self._prefill(
                 self.params, self.cache, jnp.asarray(ids),
                 jnp.asarray(positions),
@@ -962,7 +1360,9 @@ class InferenceEngine:
                 jnp.asarray([(L - 1) - start], jnp.int32),    # sample_idx
                 device_block_table(table, self.config.num_blocks),
                 self._request_key(slot.entry), temp, top_k, top_p)
-            return cache, int(tok[0])
+            tok0 = int(tok[0])      # the fetch is part of service time
+            attempt_s[0] = self._clock() - t0
+            return cache, tok0
 
         try:
             self.cache, tok0 = self._guarded_dispatch("prefill", attempt)
@@ -971,6 +1371,8 @@ class InferenceEngine:
             # (terminal "failed", blocks released) and keep serving
             self._quarantine_slot(idx)
             return True
+        self._ewma_prefill_s = self._ewma_update(self._ewma_prefill_s,
+                                                 attempt_s[0])
         self._num_prefill_chunks += 1
         slot.prefill_pos = end
         slot.context_len = max(slot.context_len, end)
@@ -1015,6 +1417,12 @@ class InferenceEngine:
         instead of the crash killing the engine."""
         self._draft_plan = {}
         if not self._drafter_ok:
+            return
+        if self._degradation_level >= 1:
+            # ladder rung 1: speculation suspended — the same
+            # empty-plan degrade path quarantine uses (a zero-proposal
+            # verify IS a single decode step, greedy-bit-identically),
+            # but REVERSIBLE: plans resume when pressure clears
             return
         S = self.config.spec_tokens
         vocab = self.model.cfg.vocab_size
@@ -1061,24 +1469,31 @@ class InferenceEngine:
     # -- decode-time block growth, CoW, preemption -------------------------
 
     def _preempt_for(self, requester: int) -> bool:
-        """Free the YOUNGEST lane to un-wedge an allocation for
-        ``requester``; its request re-queues at the front carrying its
-        generated tokens. Preempting youngest-first guarantees the
-        oldest request always progresses, so the system drains. Returns
-        False when the requester is the only lane (nothing to free —
-        the pool is simply too small for it)."""
-        cand = [(s.admit_seq, i) for i, s in enumerate(self.slots)
-                if s is not None]
+        """Free the lowest-class, youngest lane (:meth:`_yield_key`) to
+        un-wedge an allocation for ``requester``; its request re-queues
+        at the front of its class carrying its generated tokens. The
+        victim's class is >= every survivor's, so preemption never
+        inverts priority, and within the class youngest-first
+        guarantees the oldest request always progresses, so the system
+        drains. Returns False when the requester is the only lane
+        (nothing to free — the pool is simply too small for it)."""
+        cand = [i for i, s in enumerate(self.slots) if s is not None]
         if len(cand) <= 1:
             return False
-        idx = max(cand)[1]
+        idx = max(cand, key=self._yield_key)
         slot = self.slots[idx]
         gen = self._resume_tokens(slot)
         # deepest-first, same as _finish: keep evictable chains matchable
         self.allocator.free(list(reversed(slot.blocks)))
         self.waiting.appendleft(_QueueEntry(request=slot.request,
                                             arrival=slot.entry.arrival,
-                                            generated=gen))
+                                            generated=gen,
+                                            enq_t=self._clock(),
+                                            enq_tick=self._num_ticks))
+        # sample the peak at the requeue itself — admission may
+        # re-absorb the entry before step()'s end-of-tick sample
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.waiting))
         self.slots[idx] = None
         self._invalidate_lanes()
         self._num_preemptions += 1
@@ -1170,8 +1585,8 @@ class InferenceEngine:
 
         When the dispatch exhausts its retries, the batch is poisoned
         but nothing says which lane: isolation is by elimination — the
-        YOUNGEST lane is quarantined (same yield order as preemption:
-        the oldest request keeps its progress priority) and the
+        lowest-class youngest lane is quarantined (same yield order as
+        preemption, :meth:`_yield_key`) and the
         dispatch is rebuilt over the survivors, until it launches or no
         decoding lane remains. A persistent site-wide fault therefore
         fails requests one at a time instead of killing the engine."""
@@ -1216,7 +1631,7 @@ class InferenceEngine:
                 self.cache, toks = self._guarded_dispatch(
                     "decode", self._decode, *args)
             except DispatchFailedError:
-                idx = max((self.slots[i].admit_seq, i) for i in active)[1]
+                idx = max(active, key=self._yield_key)
                 self._quarantine_slot(idx)
                 active = [i for i, s in enumerate(self.slots)
                           if s is not None and s.started]
@@ -1257,6 +1672,14 @@ class InferenceEngine:
             return False
         toks, active = self._pending
         self._pending = None
+        # the decode EWMA times THIS fetch block only — the remaining
+        # in-flight device time at drain. The full launch->drain span
+        # would fold caller inter-tick pauses and host scheduling into
+        # the feasibility gate's "contention-free lower bound" and
+        # over-shed (the same reasoning that keeps retry backoff out of
+        # the prefill EWMA); under-measuring merely sheds less — the
+        # safe direction for a lower bound.
+        t_fetch = self._clock()
         try:
             toks = np.asarray(toks)
         except SimulatedCrash:
@@ -1271,8 +1694,7 @@ class InferenceEngine:
                         if self.slots[i] is not None
                         and self.slots[i].started]
                 if live:
-                    idx = max((self.slots[i].admit_seq, i)
-                              for i in live)[1]
+                    idx = max(live, key=self._yield_key)
                     self._quarantine_slot(idx)
                 self._fetch_failures = 0
             else:
@@ -1283,6 +1705,8 @@ class InferenceEngine:
             self._reset_device_state()
             return True
         self._fetch_failures = 0
+        self._ewma_decode_s = self._ewma_update(
+            self._ewma_decode_s, self._clock() - t_fetch)
         # each lane's emitted tokens are its non-sentinel prefix (lanes
         # freeze permanently mid-scan, and real token ids are >= 0)
         counts = (toks >= 0).sum(axis=1)
@@ -1339,20 +1763,95 @@ class InferenceEngine:
                     # changing admission/preemption under tight pools.)
         return True
 
+    # -- the degradation ladder (docs/robustness.md) -----------------------
+
+    def _ladder_enabled(self) -> bool:
+        return (self.config.queue_high_watermark is not None
+                or self.config.free_block_low_watermark is not None)
+
+    def _under_pressure(self) -> bool:
+        """The watermark signal: queue depth at/over the high mark, or
+        the ALLOCATABLE fraction — free plus evictable (cached)
+        blocks, the headroom ``alloc()`` can actually draw on — at/
+        under the low mark. Counting evictable as headroom matters: a
+        warm prefix cache under light traffic parks most of the pool
+        at refcount 0, and a bare free-list signal would read that
+        healthy state as overload and drive a perpetual
+        degrade/flush/re-warm sawtooth. The flip side is that rung 2's
+        flush does not relieve THIS signal (free + cached is invariant
+        under it) — correct, since block pressure the flush can't fix
+        is active-sequence pressure, which only draining relieves;
+        the flush's value is making that headroom 1-hop allocatable."""
+        cfg = self.config
+        if (cfg.queue_high_watermark is not None
+                and len(self.waiting) >= cfg.queue_high_watermark):
+            return True
+        if cfg.free_block_low_watermark is not None:
+            allocatable = (self.allocator.num_free
+                           + self.allocator.num_cached)
+            if (allocatable / max(self.allocator.num_blocks, 1)
+                    <= cfg.free_block_low_watermark):
+                return True
+        return False
+
+    def _update_ladder(self) -> bool:
+        """One hysteresis tick of the degradation ladder: after
+        ``degrade_patience`` CONSECUTIVE pressure ticks, step one rung
+        down; after as many consecutive clear ticks, one rung up —
+        deterministic, single-rung transitions, so a given (traffic,
+        clock) schedule always walks the same ladder path. While at
+        rung >= 2 every tick flushes the prefix cache's evictable
+        blocks back to the free list (trading future hits for
+        allocatable headroom). Rung 1 (speculation suspended) is
+        enforced in :meth:`_build_draft_plan`; rung 3 (lowest-class
+        admission pause) in :meth:`_admission_priority_limit`. Returns
+        whether a transition happened (it counts as step progress)."""
+        if not self._ladder_enabled():
+            return False
+        transition = False
+        if self._under_pressure():
+            self._pressure_streak += 1
+            self._clear_streak = 0
+            if (self._degradation_level < _LADDER_TOP
+                    and self._pressure_streak
+                    >= self.config.degrade_patience):
+                self._degradation_level += 1
+                self._pressure_streak = 0
+                self._num_degrade_steps_down += 1
+                transition = True
+        else:
+            self._clear_streak += 1
+            self._pressure_streak = 0
+            if (self._degradation_level > 0
+                    and self._clear_streak >= self.config.degrade_patience):
+                self._degradation_level -= 1
+                self._clear_streak = 0
+                self._num_degrade_steps_up += 1
+                transition = True
+        if self._degradation_level >= 2:
+            self._num_degrade_flushed_blocks += \
+                self.allocator.flush_evictable()
+        return transition
+
     def step(self) -> bool:
-        """One scheduler tick: expire deadlines, admit, run at most one
-        prefill chunk, drain the previous tick's in-flight decode, then
-        dispatch one fused K-step decode for every started slot (if
-        any). The drain comes AFTER admission/prefill on purpose — tick
-        t+1's host scheduling work overlaps tick t's device decode (the
-        deferred sync) — with an admission top-up behind it so lanes
-        freed by the drain (or a timeout) don't idle a tick.
+        """One scheduler tick: update the degradation ladder, expire
+        deadlines, admit, run at most one prefill chunk, drain the
+        previous tick's in-flight decode, then dispatch one fused
+        K-step decode for every started slot (if any). The drain comes
+        AFTER admission/prefill on purpose — tick t+1's host scheduling
+        work overlaps tick t's device decode (the deferred sync) — with
+        an admission top-up behind it so lanes freed by the drain (or a
+        timeout) don't idle a tick.
 
         Returns True when the tick made progress — admitted, chunked,
-        drained, expired, dispatched, preempted, or quarantined
-        something. ``run()`` turns a no-progress tick with work
-        remaining into :class:`EngineStalledError` instead of spinning.
+        drained, expired, shed, dispatched, preempted, quarantined, or
+        stepped the ladder. ``run()`` turns a no-progress tick with
+        work remaining into :class:`EngineStalledError` instead of
+        spinning.
         """
+        self._num_ticks += 1
+        pre_shed = self._num_rejected_infeasible
+        stepped = self._update_ladder()
         # waiting entries and mid-prefill slots are expirable up front
         # (so an expired slot never gets one last wasted chunk);
         # started slots only when no decode dispatch is in flight over
@@ -1367,14 +1866,20 @@ class InferenceEngine:
         expired += self._expire_deadlines(include_started=True)
         if synced or expired:
             admitted += self._admit()
-        made = bool(admitted or chunked or synced or expired)
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.waiting))
+        shed = self._num_rejected_infeasible - pre_shed
+        made = bool(admitted or chunked or synced or expired or stepped
+                    or shed)
         if all(s is None for s in self.slots):
             if self.waiting and not made:
                 # zero live sequences and nothing in flight means
                 # nothing will ever free a block — the queue head can
                 # never be admitted (the pool is undersized for it).
-                # Raise, don't spin.
-                entry = self.waiting[0]
+                # Raise, don't spin. (The ladder cannot park us here:
+                # its admission pause yields to work conservation the
+                # moment nothing more urgent exists.)
+                entry = self.waiting.head()
                 need = blocks_needed(len(entry.request.prompt) + 1,
                                      self.config.block_size)
                 raise CacheOutOfBlocks(
@@ -1417,7 +1922,8 @@ class InferenceEngine:
         request reaches a terminal state. Returns ``{uid:
         generated_token_ids}`` — or, with ``return_status=True``,
         ``{uid: RequestResult(tokens, status)}`` where ``status`` is
-        ``"finished"`` | ``"timeout"`` | ``"failed"`` (the result
+        ``"finished"`` | ``"timeout"`` | ``"failed"`` | ``"rejected"``
+        (the result
         contract in docs/serving.md; the same status is written onto
         each ``Request.status``). If a full step makes no progress
         while work remains, raises :class:`EngineStalledError` with
@@ -1445,11 +1951,18 @@ class InferenceEngine:
         retry knobs are operational, not identity: an operator
         recovering from an incident may legitimately restore into an
         engine with a bigger retry budget or no backoff, and outputs
-        are unaffected, so they stay out of the fingerprint."""
+        are unaffected, so they stay out of the fingerprint. The
+        overload knobs (queue bound, ladder watermarks, admission-pause
+        class) are operational in the same sense — restoring into a
+        replica with a bigger queue or different watermarks is exactly
+        the incident-recovery move — so they stay out too."""
         d = dataclasses.asdict(self.config)
         d["kv_dtype"] = (None if self.config.kv_dtype is None
                          else str(jnp.dtype(self.config.kv_dtype)))
-        for knob in ("max_dispatch_retries", "retry_backoff_s"):
+        for knob in ("max_dispatch_retries", "retry_backoff_s",
+                     "max_waiting", "queue_high_watermark",
+                     "free_block_low_watermark", "degrade_patience",
+                     "degrade_admit_priority"):
             d.pop(knob, None)
         return d
 
@@ -1465,6 +1978,7 @@ class InferenceEngine:
                          "top_k": int(req.sampling.top_k),
                          "top_p": float(req.sampling.top_p)},
             "arrival": int(entry.arrival),
+            "priority": int(req.priority),
             "generated": [int(t) for t in entry.generated],
         }
         dl = self._deadline.get(req.uid)
@@ -1517,6 +2031,20 @@ class InferenceEngine:
             # (empty-plan) run never drew, breaking sampled-lane
             # restore bit-identity
             "drafter_ok": bool(self._drafter_ok),
+            # behavioral too: a restored engine continues the SAME
+            # ladder walk (its rung gates speculation and admission),
+            # streaks included so hysteresis resumes mid-count — and
+            # the feasibility-gate EWMAs ride along, or the restored
+            # gate would reopen blind and admit doomed tight-deadline
+            # requests at exactly the moment load is highest (restore
+            # re-queues every previously resident request)
+            "overload": {
+                "degradation_level": int(self._degradation_level),
+                "pressure_streak": int(self._pressure_streak),
+                "clear_streak": int(self._clear_streak),
+                "ewma_prefill_s": self._ewma_prefill_s,
+                "ewma_decode_s": self._ewma_decode_s,
+            },
             "block_tables": {
                 self.slots[i].request.uid: [int(b) for b in
                                             self.slots[i].blocks]
@@ -1559,13 +2087,16 @@ class InferenceEngine:
                     top_k=rec["sampling"]["top_k"],
                     top_p=rec["sampling"]["top_p"]),
                 eos_token_id=rec.get("eos_token_id"),
-                deadline_s=deadline)
+                deadline_s=deadline,
+                priority=int(rec.get("priority", 0)))
             if deadline is not None:
                 # an already-blown deadline stays blown (<= now)
                 self._deadline[req.uid] = now + deadline
+            self._live_uids.add(req.uid)
             self.waiting.append(_QueueEntry(
                 request=req, arrival=int(rec["arrival"]),
-                generated=[int(t) for t in rec["generated"]]))
+                generated=[int(t) for t in rec["generated"]],
+                enq_t=now, enq_tick=self._num_ticks))
         self._arrival_count = int(snap["arrival_count"])
         self.finished.update({uid: [int(t) for t in toks]
                               for uid, toks in snap["finished"].items()})
@@ -1578,6 +2109,30 @@ class InferenceEngine:
         # with an equivalent (pure-function-of-history) drafter.
         self._drafter_ok = (bool(snap["drafter_ok"])
                             and self.config.spec_tokens > 0)
+        # the ladder resumes where the snapshot left it — rungs gate
+        # speculation and admission, so a restore mid-degradation must
+        # not silently jump back to full service (the restoring
+        # engine's own watermarks walk it up when pressure clears).
+        # UNLESS this engine's ladder is disabled (no watermarks — the
+        # overload knobs are legitimately restorable-across, like the
+        # retry knobs): _update_ladder could then never step the rung
+        # back up, leaving speculation/admission degraded FOREVER —
+        # same config-mismatch guard as drafter_ok above
+        overload = snap.get("overload", {})
+        if self._ladder_enabled():
+            self._degradation_level = int(
+                overload.get("degradation_level", 0))
+            self._pressure_streak = int(overload.get("pressure_streak", 0))
+            self._clear_streak = int(overload.get("clear_streak", 0))
+        # the gate's estimators restore UNCONDITIONALLY (they exist
+        # independent of the ladder): a blind re-opened gate would
+        # admit doomed deadlines right when the requeued backlog is
+        # largest. Absent keys (older snapshots) leave the gate open.
+        for attr, key in (("_ewma_prefill_s", "ewma_prefill_s"),
+                          ("_ewma_decode_s", "ewma_decode_s")):
+            v = overload.get(key)
+            if v is not None:
+                setattr(self, attr, float(v))
         self._num_restores += 1
 
     def check_allocator_integrity(self) -> None:
@@ -1633,6 +2188,31 @@ class InferenceEngine:
             "num_quarantines": self._num_quarantines,
             "num_snapshots": self._num_snapshots,
             "num_restores": self._num_restores,
+            # overload observability (docs/robustness.md): queue depth
+            # and wait, shed counters, and the degradation ladder —
+            # overload must be visible HERE before the first timeout
+            # ever fires
+            "num_ticks": self._num_ticks,
+            "queue_depth": len(self.waiting),
+            "queue_depth_peak": self._queue_depth_peak,
+            "queue_wait_mean_ticks": (
+                self._queue_wait_ticks_sum / self._queue_wait_count
+                if self._queue_wait_count else 0.0),
+            "queue_wait_max_ticks": self._queue_wait_ticks_max,
+            "queue_wait_mean_s": (
+                self._queue_wait_s_sum / self._queue_wait_count
+                if self._queue_wait_count else 0.0),
+            "queue_wait_max_s": self._queue_wait_s_max,
+            "num_rejected_queue_full": self._num_rejected_queue_full,
+            "num_rejected_infeasible": self._num_rejected_infeasible,
+            "ewma_prefill_dispatch_s": float(self._ewma_prefill_s or 0.0),
+            "ewma_decode_dispatch_s": float(self._ewma_decode_s or 0.0),
+            "degradation_level": self._degradation_level,
+            "num_degrade_steps_down": self._num_degrade_steps_down,
+            "num_degrade_steps_up": self._num_degrade_steps_up,
+            "num_degrade_flushed_blocks": self._num_degrade_flushed_blocks,
+            "admission_paused": int(
+                self._admission_priority_limit() is not None),
             # speculative decoding (docs/serving.md): proposed vs
             # accepted draft tokens — the acceptance rate is THE
             # speculation health metric (tokens per target forward =
@@ -1647,5 +2227,8 @@ class InferenceEngine:
             "num_drafter_quarantines": self._num_drafter_quarantines,
             "num_spec_blocks_rolled_back":
                 self._num_spec_blocks_rolled_back,
-            "speculation_active": int(self._drafter_ok),
+            # 0 while quarantined (permanent) OR suspended by the
+            # degradation ladder (reversible)
+            "speculation_active": int(self._drafter_ok
+                                      and self._degradation_level < 1),
         }

@@ -321,6 +321,18 @@ class BlockAllocator:
         self.free(list(reversed(tail)))
         return blocks[:keep]
 
+    def flush_evictable(self) -> int:
+        """Evict EVERY cached (refcount-0, prefix-indexed) block back
+        to the free list — the degradation ladder's aggressive-eviction
+        rung (docs/robustness.md): under sustained pool pressure the
+        engine trades future prefix hits for immediately-allocatable
+        headroom. Each drop counts as an eviction (the blocks really do
+        leave the index). Returns how many blocks were flushed."""
+        n = len(self._evictable)
+        while self._evictable:
+            self._free.append(self._evict_one())
+        return n
+
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._ref.clear()

@@ -1251,6 +1251,202 @@ def bench_serving_speculative(fast=False):
     }
 
 
+def bench_serving_overload(fast=False):
+    """Overload / tail-latency arm (round 8): a seeded bursty trace —
+    Poisson-ish arrivals with a 4x burst phase in the middle, mixed
+    prompt/output lengths, mixed priorities and deadlines — driven
+    tick-by-tick through an engine with the full overload-protection
+    stack on: bounded queue (``try_add`` sheds at the door), admit-time
+    feasibility gate, and degradation-ladder watermarks. Reports
+    p50/p99 TTFT (submit -> first host-visible token), p50/p99
+    inter-token latency (host-visible gaps; tokens surfacing in the
+    same drain batch count as 0), goodput (SLO-attained tokens/s:
+    tokens of requests that FINISHED — shed/timed-out requests
+    contribute zero) alongside raw generated tokens/s, the shed/timeout
+    counts, ladder transitions, and the queue high-water mark — and
+    ASSERTS zero engine stalls and a bounded queue, so an overload
+    regression fails the bench instead of doubling p99 silently.
+    ``vs_baseline`` is goodput / raw throughput (the SLO-attainment
+    fraction). ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
+                                  SamplingParams)
+
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ecfg = EngineConfig(max_batch=16, block_size=32, num_blocks=512,
+                            max_prefill_len=256, max_seq_len=512,
+                            kv_dtype=jnp.bfloat16, max_waiting=64,
+                            queue_high_watermark=32,
+                            free_block_low_watermark=0.125,
+                            degrade_patience=2)
+        base_rate, phase_ticks = 1.0, 40
+        prompt_lens, max_news = (64, 128, 192), (16, 32, 64)
+        deadlines = (None, None, 0.05, 2.0, 6.0)
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ecfg = EngineConfig(max_batch=4, block_size=8, num_blocks=64,
+                            max_prefill_len=16, max_seq_len=48,
+                            max_waiting=8, queue_high_watermark=5,
+                            free_block_low_watermark=0.125,
+                            degrade_patience=2)
+        base_rate = 0.3 if fast else 0.4
+        phase_ticks = 8 if fast else 24
+        prompt_lens, max_news = (6, 10, 14), (3, 5, 8)
+        # the 0.02 s class is the feasibility-gate bait: once the EWMAs
+        # see real dispatch times it is shed at admission, not timed out
+        deadlines = (None, None, 0.02, 1.5, 5.0)
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.RandomState(_SALT + 3)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))
+    engine = InferenceEngine(model, params, ecfg)
+
+    # warmup: compile the two programs outside the clock
+    for i in range(2):
+        engine.add_request(Request(
+            uid=f"warm-{i}",
+            prompt=list(rng.randint(0, cfg.vocab_size, prompt_lens[0])),
+            max_new_tokens=2))
+    engine.run()
+
+    # the trace, built up front (seeded => the same burst every round):
+    # arrivals-per-tick ~ Poisson(rate); the middle phase runs at 4x
+    trace, uid = [], 0
+    for tick in range(3 * phase_ticks):
+        burst = phase_ticks <= tick < 2 * phase_ticks
+        for _ in range(int(rng.poisson(base_rate * (4 if burst else 1)))):
+            dl = deadlines[int(rng.randint(len(deadlines)))]
+            trace.append((tick, Request(
+                uid=f"o{uid}",
+                prompt=list(rng.randint(
+                    0, cfg.vocab_size,
+                    int(rng.choice(prompt_lens)))),
+                max_new_tokens=int(rng.choice(max_news)),
+                priority=int(rng.choice((0, 1, 2), p=(0.3, 0.5, 0.2))),
+                deadline_s=dl,
+                sampling=(SamplingParams() if uid % 2 == 0 else
+                          SamplingParams(temperature=1.0, top_k=40)))))
+            uid += 1
+
+    submit_t, first_tok_t, last_obs_t, last_counts = {}, {}, {}, {}
+    ttfts, gaps = [], []
+    shed_at_door = stalls = 0
+
+    def observe(now):
+        # host-visible token counts for every request still owned by
+        # the engine (finished-but-undrained, resident, or requeued)
+        counts = {u: len(t) for u, t in engine.finished.items()}
+        for s in engine.slots:
+            if s is not None:
+                counts[s.request.uid] = (len(s.generated) if s.started
+                                         else len(s.entry.generated))
+        for e in engine.waiting:
+            counts[e.request.uid] = len(e.generated)
+        for u, n in counts.items():
+            prev = last_counts.get(u, 0)
+            if n <= prev or u not in submit_t:
+                continue
+            if u not in first_tok_t:
+                first_tok_t[u] = now
+                ttfts.append(now - submit_t[u])
+                if n > 1:   # surfaced in the same drain batch
+                    gaps.extend([0.0] * (n - 1))
+            else:
+                gaps.extend([(now - last_obs_t[u]) / (n - prev)]
+                            * (n - prev))
+            last_obs_t[u] = now
+            last_counts[u] = n
+
+    t0 = time.perf_counter()
+    i = tick = 0
+    while i < len(trace) or engine.has_work:
+        while i < len(trace) and trace[i][0] <= tick:
+            req = trace[i][1]
+            submit_t[req.uid] = time.perf_counter()
+            if not engine.try_add(req):      # bounded queue: shed at
+                shed_at_door += 1            # the door, explicitly
+                submit_t.pop(req.uid, None)
+            i += 1
+        had_work = engine.has_work
+        progressed = engine.step()
+        if had_work and not progressed:
+            stalls += 1
+        observe(time.perf_counter())
+        tick += 1
+    wall = time.perf_counter() - t0
+
+    results = engine.run(return_status=True)   # drain terminal maps
+    status_counts = {}
+    for r in results.values():
+        status_counts[r.status] = status_counts.get(r.status, 0) + 1
+    raw_tokens = sum(len(r.tokens) for r in results.values())
+    good_tokens = sum(len(r.tokens) for r in results.values()
+                      if r.status == "finished")
+    goodput = good_tokens / max(wall, 1e-9)
+    raw_tps = raw_tokens / max(wall, 1e-9)
+    stats = engine.stats()
+
+    assert stalls == 0, f"{stalls} no-progress ticks with work remaining"
+    # client adds are bounded by max_waiting; preemption/recovery
+    # requeues of residents can push past it by at most max_batch
+    assert (stats["queue_depth_peak"]
+            <= ecfg.max_waiting + ecfg.max_batch), stats
+    assert status_counts.get("finished", 0) > 0, status_counts
+
+    def pct(xs, q):
+        return float(np.percentile(xs, q)) if xs else 0.0
+
+    print(f"# serving overload: {len(trace)} offered "
+          f"({shed_at_door} shed at door) over {tick} ticks | "
+          f"goodput {goodput:.1f} of {raw_tps:.1f} tok/s | TTFT p50 "
+          f"{pct(ttfts, 50) * 1e3:.1f}ms p99 {pct(ttfts, 99) * 1e3:.1f}ms"
+          f" | ITL p50 {pct(gaps, 50) * 1e3:.2f}ms p99 "
+          f"{pct(gaps, 99) * 1e3:.2f}ms | queue peak "
+          f"{int(stats['queue_depth_peak'])}/{ecfg.max_waiting} | "
+          f"rejected {int(stats['num_rejected_infeasible'])} infeasible"
+          f" + {int(stats['num_rejected_queue_full'])} full | ladder "
+          f"down {int(stats['num_degrade_steps_down'])} / up "
+          f"{int(stats['num_degrade_steps_up'])}", file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_overload_goodput_tokens_per_sec"
+                   if on_tpu else
+                   "serving_tiny_overload_goodput_tokens_per_sec"),
+        "value": round(goodput, 3),
+        "unit": "tokens/sec",
+        # the SLO-attainment fraction: how much of the raw token
+        # stream belonged to requests that actually finished
+        "vs_baseline": round(goodput / max(raw_tps, 1e-9), 4),
+        "burst_factor": 4,
+        "num_requests_offered": len(trace),
+        "num_requests_admitted": len(results),
+        "num_shed_at_door": shed_at_door,
+        "status_counts": status_counts,
+        "p50_ttft_s": round(pct(ttfts, 50), 6),
+        "p99_ttft_s": round(pct(ttfts, 99), 6),
+        "p50_itl_s": round(pct(gaps, 50), 6),
+        "p99_itl_s": round(pct(gaps, 99), 6),
+        "goodput_tokens_per_sec": round(goodput, 3),
+        "decode_tokens_per_sec": round(raw_tps, 3),
+        "slo_attainment": round(good_tokens / max(raw_tokens, 1), 4),
+        "num_stalls": stalls,
+        "max_waiting": int(ecfg.max_waiting),
+        "max_batch": int(ecfg.max_batch),
+        "queue_depth_peak": int(stats["queue_depth_peak"]),
+        "num_rejected_queue_full": int(stats["num_rejected_queue_full"]),
+        "num_rejected_infeasible": int(stats["num_rejected_infeasible"]),
+        "num_timeouts": int(stats["num_timeouts"]),
+        "num_preemptions": int(stats["num_preemptions"]),
+        "degrade_steps_down": int(stats["num_degrade_steps_down"]),
+        "degrade_steps_up": int(stats["num_degrade_steps_up"]),
+        "queue_wait_mean_s": round(float(stats["queue_wait_mean_s"]), 6),
+        "queue_wait_max_s": round(float(stats["queue_wait_max_s"]), 6),
+    }
+
+
 def bench_train_step(fast=False):
     """Fused train step (apex_tpu.train): the whole global optimizer
     step — amp O2 scaled forward/backward, ``accum_steps`` scanned
@@ -1430,6 +1626,8 @@ def main():
              lambda: bench_serving_multistep(fast=True)),
             ("bench_serving_speculative",
              lambda: bench_serving_speculative(fast=True)),
+            ("bench_serving_overload",
+             lambda: bench_serving_overload(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
         ):
             if not _run_section(name, fn, retries=0):
@@ -1492,7 +1690,8 @@ def main():
     # S=2048 with --long-context)
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
                  bench_serving, bench_serving_multistep,
-                 bench_serving_speculative, bench_train_step]
+                 bench_serving_speculative, bench_serving_overload,
+                 bench_train_step]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

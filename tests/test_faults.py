@@ -519,6 +519,95 @@ def test_snapshot_restores_in_fresh_process(tiny_gpt, reference_outputs,
 
 
 # ---------------------------------------------------------------------------
+# serving: overload chaos (ISSUE 8 — burst + faults + deadlines)
+# ---------------------------------------------------------------------------
+
+
+def test_overload_burst_with_faults_never_stalls_and_bounds_queue(
+        tiny_gpt):
+    """A 4x burst wave through a bounded queue WITH transient faults
+    and a deadline/priority mix: the engine must never stall, never let
+    client adds push the queue past ``max_waiting`` (requeues of
+    residents may add at most ``max_batch``), and land every accepted
+    request on a terminal status."""
+    plan = FaultPlan([FaultSpec(site="prefill", kind="transient", at=(1,)),
+                      FaultSpec(site="decode", kind="transient",
+                                at=(2, 5))])
+    now = [0.0]
+    engine = _mk_engine(tiny_gpt, faults=plan, clock=lambda: now[0],
+                        max_waiting=4, queue_high_watermark=3,
+                        degrade_patience=1)
+    rng = np.random.RandomState(3)
+    offered = accepted = uid = 0
+    # three waves: pre / 4x burst / post
+    for count in (2, 8, 2):
+        for _ in range(count):
+            r = Request(f"o{uid}",
+                        list(rng.randint(1, 100, 3 + uid % 4)),
+                        max_new_tokens=3 + uid % 3,
+                        priority=uid % 2,
+                        deadline_s=(1.0 if uid % 3 == 0 else None))
+            offered += 1
+            accepted += int(engine.try_add(r))
+            uid += 1
+        for _ in range(2):
+            had = engine.has_work
+            progressed = engine.step()
+            assert progressed or not had      # the stall contract
+            now[0] += 0.4
+    out = engine.run(return_status=True)
+    s = engine.stats()
+    assert accepted < offered                 # the burst really shed
+    assert s["num_rejected_queue_full"] == offered - accepted
+    assert s["queue_depth_peak"] <= 4 + engine.config.max_batch
+    assert len(out) == accepted               # every accepted: terminal
+    assert {r.status for r in out.values()} <= {
+        "finished", "timeout", "failed", "rejected"}
+    assert sum(r.status == "finished" for r in out.values()) > 0
+    assert s["num_dispatch_retries"] >= 1     # the faults really fired
+    assert s["num_degrade_steps_down"] >= 1   # the ladder really moved
+    assert not engine.has_work
+
+
+def test_restore_mid_degradation_is_bit_identical(tiny_gpt):
+    """Snapshot taken WHILE the degradation ladder is engaged, restored
+    into a fresh engine: the ladder state rides the snapshot and the
+    combined outputs equal the uninterrupted run bit-for-bit (ladder
+    transitions are schedule changes; sampling is schedule-invariant,
+    sampled lanes included)."""
+    kw = dict(max_batch=1, queue_high_watermark=2, degrade_patience=1)
+
+    def reqs():
+        return [Request(f"r{i}", [10 + i, 20 + i, 30 + i],
+                        max_new_tokens=4, priority=i % 2,
+                        sampling=(SamplingParams(temperature=0.8,
+                                                 top_k=12)
+                                  if i == 2 else SamplingParams()))
+                for i in range(4)]
+
+    ref_engine = _mk_engine(tiny_gpt, **kw)
+    for r in reqs():
+        ref_engine.add_request(r)
+    ref = ref_engine.run()
+
+    engine = _mk_engine(tiny_gpt, **kw)
+    for r in reqs():
+        engine.add_request(r)
+    while engine.stats()["degradation_level"] < 1:
+        engine.step()
+    snap = json.loads(json.dumps(engine.snapshot()))
+    assert snap["overload"]["degradation_level"] >= 1
+    restored = _mk_engine(tiny_gpt, **kw)
+    restored.restore(snap)
+    assert (restored.stats()["degradation_level"]
+            == snap["overload"]["degradation_level"])
+    combined = {u: list(t) for u, t in snap["finished"].items()}
+    combined.update(restored.run())
+    assert combined == ref
+    restored.check_allocator_integrity()
+
+
+# ---------------------------------------------------------------------------
 # training: retry, watchdog escalation, checkpoint/resume
 # ---------------------------------------------------------------------------
 

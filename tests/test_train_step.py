@@ -10,6 +10,7 @@ only a warning, so absence-of-error proves nothing).
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -540,6 +541,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_smoke_decode_steps_per_sec",
         "serving_tiny_smoke_multistep_decode_tokens_per_sec",
         "serving_tiny_speculative_decode_tokens_per_sec",
+        "serving_tiny_overload_goodput_tokens_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
     }
     for r in records:
@@ -553,6 +555,19 @@ def test_bench_smoke_mode_every_section_rc0():
     assert spec["acceptance_rate"] > 0, spec
     assert spec["arms"]["speculative"]["num_accepted_tokens"] > 0, spec
     assert spec["outputs_bit_identical"] is True, spec
+    # the overload arm's latency percentiles and goodput must be
+    # present and FINITE (the r01/r05 dead-section lesson extended to
+    # the tail-latency arm: a NaN percentile is a quiet perf lie), with
+    # zero engine stalls and the queue bound respected
+    ov = [r for r in records
+          if r.get("metric") == "serving_tiny_overload_goodput_tokens_per_sec"][0]
+    for key in ("p50_ttft_s", "p99_ttft_s", "p50_itl_s", "p99_itl_s",
+                "goodput_tokens_per_sec", "decode_tokens_per_sec",
+                "slo_attainment"):
+        assert key in ov and math.isfinite(ov[key]), (key, ov)
+    assert ov["num_stalls"] == 0, ov
+    assert ov["queue_depth_peak"] <= ov["max_waiting"] + ov["max_batch"]
+    assert ov["status_counts"].get("finished", 0) > 0, ov
     # every section also leaves a wall-time/exit-status record, so a
     # section that dies is a visible "failed" entry in the artifact,
     # never just an absence
@@ -560,7 +575,8 @@ def test_bench_smoke_mode_every_section_rc0():
     assert set(sections) == {
         "bench_layer_norm", "bench_fused_lamb", "bench_ddp_scaling",
         "bench_serving", "bench_serving_multistep",
-        "bench_serving_speculative", "bench_train_step",
+        "bench_serving_speculative", "bench_serving_overload",
+        "bench_train_step",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec
