@@ -22,8 +22,11 @@ from apex_tpu.serving.engine import (  # noqa: F401
     QueueFullError,
     Request,
     RequestResult,
+    TenantQuota,
+    TenantThrottledError,
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
+    DEFAULT_TENANT,
     BlockAllocator,
     CacheOutOfBlocks,
     DeviceMirror,

@@ -134,14 +134,34 @@ queue-depth watermarks with hysteresis): suspend speculative decoding,
 flush the prefix cache aggressively, pause admission of the lowest
 priority class — and back up when pressure clears, every transition
 counted in ``stats()`` and serialized through snapshot/restore.
+
+**Multi-tenant isolation** (docs/robustness.md): overload protection
+treats traffic as one cooperating client; real traffic is mutually
+untrusting tenants. Every request carries a ``tenant`` id: admission
+WITHIN a priority class is weighted deficit-round-robin across tenants
+(strict priority between classes is kept — the documented contract),
+per-tenant quotas (:class:`TenantQuota`: waiting entries, fractional
+resident-block charge, token rate) shed over-quota submissions with
+terminal status ``"throttled"`` before they burn pool blocks, and the
+allocator attributes every block reference — shared prefix blocks
+fractionally by refcount — so flushes and evictions charge the tenant
+that parked them. Two client-lifecycle primitives ride the tenant
+ledger: :meth:`InferenceEngine.abort` (cancellation with full
+resource reclamation, status ``"cancelled"``) and
+:meth:`InferenceEngine.pop_stream_events` (streaming ``(uid, token,
+is_last)`` delivery; a disconnect callback maps onto ``abort``).
+Tenancy is pure scheduling: sampling stays arrival-keyed, so outputs
+are invariant to tenant assignment, and uniform-tenant traffic is
+bit-identical to the pre-tenancy engine.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +175,7 @@ from apex_tpu.utils.faults import (
 )
 
 from apex_tpu.serving.kv_cache import (
+    DEFAULT_TENANT,
     BlockAllocator,
     CacheOutOfBlocks,
     DeviceMirror,
@@ -179,6 +200,56 @@ _EWMA_ALPHA = 0.25
 # 2 = + prefix cache flushed every tick, 3 = + lowest-class admission
 # paused
 _LADDER_TOP = 3
+# while the dynamic speculation cap (spec_adapt) sits at 0, every Nth
+# decode phase runs a 1-token probe so a recovered drafter can earn
+# its cap back (a capped-out engine otherwise never observes
+# acceptance again and stays degraded forever)
+_SPEC_PROBE_EVERY = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant resource bounds (``EngineConfig.tenant_quotas``), all
+    optional — ``None`` leaves that axis unbounded. Enforcement points
+    (docs/robustness.md, isolation):
+
+    - ``max_waiting``: entries the tenant may hold in the waiting queue
+      at once; the door sheds past it with terminal status
+      ``"throttled"`` (:class:`TenantThrottledError`; ``try_add``
+      returns False).
+    - ``max_resident_blocks``: the tenant's fractional resident-block
+      charge ceiling (:meth:`~apex_tpu.serving.kv_cache.BlockAllocator.
+      tenant_charge` — shared prefix blocks charge fractionally by
+      refcount). A request whose worst-case private footprint exceeds
+      it is shed ``"throttled"`` at the door (it could never run);
+      admission skips an over-charge tenant's queue (other tenants
+      flow past); decode-time growth past the cap preempts the
+      tenant's OWN lowest-class/youngest other lane, never a
+      different tenant's.
+    - ``tokens_per_s``: token-rate budget, enforced at the door
+      against an exponentially-decayed per-tenant rate estimator
+      (``tenant_rate_tau_s``); over-rate submissions shed
+      ``"throttled"`` before touching the queue or the pool.
+    """
+
+    max_waiting: Optional[int] = None
+    max_resident_blocks: Optional[int] = None
+    tokens_per_s: Optional[float] = None
+
+    def validate(self, tenant: str) -> None:
+        if self.max_waiting is not None and self.max_waiting < 1:
+            raise ValueError(
+                f"tenant {tenant!r}: max_waiting must be >= 1 (or None), "
+                f"got {self.max_waiting}")
+        if (self.max_resident_blocks is not None
+                and self.max_resident_blocks < 1):
+            raise ValueError(
+                f"tenant {tenant!r}: max_resident_blocks must be >= 1 "
+                f"(or None), got {self.max_resident_blocks}")
+        if self.tokens_per_s is not None and self.tokens_per_s <= 0:
+            raise ValueError(
+                f"tenant {tenant!r}: tokens_per_s must be > 0 (or "
+                f"None), got {self.tokens_per_s}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -207,10 +278,20 @@ class Request:
     # uniform-priority traffic is bit-identical to the pre-priority
     # FIFO scheduler.
     priority: int = 0
+    # The submitting tenant: admission WITHIN a priority class is
+    # weighted deficit-round-robin across tenants (strict priority
+    # between classes is unchanged), and per-tenant quotas
+    # (EngineConfig.tenant_quotas) are enforced against this id. A
+    # pure SCHEDULING/ADMISSION label like priority: sampling is
+    # arrival-keyed, so per-request outputs are identical under any
+    # tenant assignment (tested), and uniform-tenant traffic is
+    # bit-identical to the pre-tenancy engine.
+    tenant: str = DEFAULT_TENANT
     # Terminal lifecycle status — "finished" | "timeout" | "failed" |
-    # "rejected" — written by the engine via object.__setattr__ when
-    # the request leaves it (the one engine-owned field of the frozen
-    # request); None while waiting/active. Excluded from equality/hash.
+    # "rejected" | "throttled" | "cancelled" — written by the engine
+    # via object.__setattr__ when the request leaves it (the one
+    # engine-owned field of the frozen request); None while
+    # waiting/active. Excluded from equality/hash.
     status: Optional[str] = dataclasses.field(default=None, compare=False)
 
 
@@ -219,8 +300,9 @@ class RequestResult:
     """One entry of ``run(return_status=True)``: the generated tokens
     plus the request's terminal status (the result contract in
     docs/serving.md). ``tokens`` may be shorter than ``max_new_tokens``
-    for ``"timeout"``/``"failed"``/``"rejected"`` exits — everything
-    emitted before the cut is preserved."""
+    for ``"timeout"``/``"failed"``/``"rejected"``/``"throttled"``/
+    ``"cancelled"`` exits — everything emitted before the cut is
+    preserved."""
 
     tokens: List[int]
     status: str
@@ -232,6 +314,17 @@ class QueueFullError(RuntimeError):
     signal — callers shed, retry later, or route to another replica
     instead of growing an unbounded queue that will only manufacture
     timeouts. ``try_add`` is the non-raising variant."""
+
+
+class TenantThrottledError(RuntimeError):
+    """``add_request`` refused by the submitting TENANT's quota
+    (:class:`TenantQuota`): its waiting-entry cap, its resident-block
+    ceiling (a request that could never fit it), or its token-rate
+    budget. Unlike the engine-wide :class:`QueueFullError` door shed,
+    a throttled request DOES get a terminal verdict — status
+    ``"throttled"``, zero tokens, drained by ``run()`` — because the
+    shed is the tenant's own doing, not global load, and the tenant's
+    ledger must show it. ``try_add`` returns False for this too."""
 
 
 class EngineStalledError(RuntimeError):
@@ -323,6 +416,43 @@ class EngineConfig:
     free_block_low_watermark: Optional[float] = None
     degrade_patience: int = 2
     degrade_admit_priority: int = 1
+    # -- multi-tenant isolation (docs/robustness.md) -------------------
+    # DRR weight per tenant id (>= 1; unlisted tenants weigh 1): each
+    # visit of the admission walk credits a tenant weight * drr_quantum
+    # deficit "tokens" (a request costs its committed budget,
+    # len(prompt) + max_new_tokens, charged ONCE — preemption requeues
+    # and restores re-admit free), so a weight-3 tenant admits ~3x the
+    # token volume of a weight-1 tenant under contention. None = every
+    # tenant weighs 1. Pure scheduling: sampling is arrival-keyed, so
+    # outputs are invariant to weights, and single-tenant traffic is
+    # bit-identical to the pre-tenancy engine at ANY weight.
+    tenant_weights: Optional[Mapping[str, int]] = None
+    # Per-tenant resource bounds (TenantQuota); unlisted tenants are
+    # unbounded. None = no quotas (the pre-tenancy behavior).
+    tenant_quotas: Optional[Mapping[str, "TenantQuota"]] = None
+    # The DRR credit per walk visit, in committed-budget tokens.
+    # Smaller = finer-grained interleaving across tenants; larger =
+    # longer per-tenant admission bursts. Irrelevant with one tenant.
+    drr_quantum: int = 64
+    # Time constant (seconds) of the per-tenant token-rate estimator
+    # feeding TenantQuota.tokens_per_s: the observed rate decays as
+    # exp(-dt / tau), and each delivered token adds 1/tau — a larger
+    # tau forgives longer bursts around the same average rate.
+    tenant_rate_tau_s: float = 1.0
+    # -- dynamic speculation (docs/serving.md) -------------------------
+    # Adapt the per-plan draft cap to the observed acceptance rate: an
+    # EWMA of per-dispatch acceptance shrinks the cap by one (toward 0
+    # = speculation off, riding the ladder's rung-1 empty-plan
+    # machinery) whenever it sits below spec_accept_low, and restores
+    # it by one (toward spec_tokens) above spec_accept_high — the
+    # [low, high] dead band is the hysteresis. While the cap is 0, a
+    # 1-token probe runs every 16th decode phase so recovery is
+    # possible. Requires spec_tokens > 0. When acceptance stays at or
+    # above spec_accept_high, the cap never moves and the engine is
+    # bit-identical to static speculation (tested).
+    spec_adapt: bool = False
+    spec_accept_low: float = 0.5
+    spec_accept_high: float = 0.8
     seed: int = 0
 
     def __post_init__(self):
@@ -385,6 +515,34 @@ class EngineConfig:
             raise ValueError(
                 f"degrade_admit_priority must be >= 1 (0 would pause "
                 f"every class), got {self.degrade_admit_priority}")
+        if self.tenant_weights is not None:
+            for t, w in self.tenant_weights.items():
+                if int(w) < 1:
+                    raise ValueError(
+                        f"tenant_weights[{t!r}] must be >= 1, got {w}")
+        if self.tenant_quotas is not None:
+            for t, q in self.tenant_quotas.items():
+                if not isinstance(q, TenantQuota):
+                    raise ValueError(
+                        f"tenant_quotas[{t!r}] must be a TenantQuota, "
+                        f"got {type(q).__name__}")
+                q.validate(t)
+        if self.drr_quantum < 1:
+            raise ValueError(
+                f"drr_quantum must be >= 1, got {self.drr_quantum}")
+        if self.tenant_rate_tau_s <= 0:
+            raise ValueError(
+                f"tenant_rate_tau_s must be > 0, got "
+                f"{self.tenant_rate_tau_s}")
+        if self.spec_adapt and self.spec_tokens < 1:
+            raise ValueError(
+                "spec_adapt requires spec_tokens >= 1 (there is no "
+                "draft cap to adapt at spec_tokens == 0)")
+        if not 0.0 <= self.spec_accept_low <= self.spec_accept_high <= 1.0:
+            raise ValueError(
+                f"spec acceptance thresholds must satisfy 0 <= low <= "
+                f"high <= 1, got low={self.spec_accept_low} "
+                f"high={self.spec_accept_high}")
 
 
 @dataclasses.dataclass
@@ -409,82 +567,293 @@ class _QueueEntry:
     hashes: Optional[List[str]] = None
     enq_t: float = 0.0
     enq_tick: int = 0
+    # whether the entry's DRR cost (the committed token budget) was
+    # already charged against its tenant's deficit: admission charges
+    # exactly once, so preemption/crash-recovery requeues and restored
+    # residents re-admit FREE and ahead of uncharged work (the old
+    # front-of-the-class requeue discipline, tenant-aware)
+    drr_charged: bool = False
+
+
+class _ClassQueue:
+    """One priority class of the waiting queue: per-tenant FIFO
+    :class:`deque`\\ s plus the class's DRR walk state. ``ring`` lists
+    the tenants with non-empty deques in first-enqueue order;
+    ``cursor`` is the walk's current ring position, ``credited``
+    whether the cursor tenant has received its quantum for the current
+    visit, ``deficits`` the per-tenant leftover credit. A tenant whose
+    deque drains leaves the ring and forfeits its deficit (standard
+    DRR — credit never accumulates while you have nothing queued)."""
+
+    __slots__ = ("queues", "ring", "cursor", "credited", "deficits")
+
+    def __init__(self):
+        self.queues: Dict[str, deque] = {}
+        self.ring: List[str] = []
+        self.cursor: int = 0
+        self.credited: bool = False
+        self.deficits: Dict[str, float] = {}
+
+    def remove_tenant(self, tenant: str) -> None:
+        i = self.ring.index(tenant)
+        self.ring.pop(i)
+        del self.queues[tenant]
+        self.deficits.pop(tenant, None)
+        if not self.ring:
+            self.cursor, self.credited = 0, False
+            return
+        if i < self.cursor:
+            self.cursor -= 1
+        elif i == self.cursor:
+            # the cursor now points at the NEXT tenant — a fresh visit
+            self.credited = False
+            if self.cursor >= len(self.ring):
+                self.cursor = 0
 
 
 class _WaitingQueue:
-    """The waiting queue, priority-aware: one FIFO :class:`deque` per
-    priority class, scanned in ascending class value (0 = most urgent).
-    ``append`` enqueues at the tail of the request's class,
-    ``appendleft`` (preemption / crash-recovery requeues) at its head —
-    exactly the old single-deque discipline per class, so
-    uniform-priority traffic degenerates to the pre-priority FIFO
-    bit-for-bit. Iteration order is admission order (class by class,
-    FIFO within), which is also the snapshot serialization order."""
+    """The waiting queue: strict priority BETWEEN classes (scanned in
+    ascending class value, 0 = most urgent — the documented PR 8
+    contract), weighted deficit-round-robin across TENANTS within each
+    class (:class:`_ClassQueue`). ``append`` enqueues at the tail of
+    the request's (class, tenant) FIFO, ``appendleft`` (preemption /
+    crash-recovery requeues) at its head. Entries whose DRR cost was
+    already charged (``drr_charged`` — requeues, restored residents)
+    are served OUT OF BAND ahead of the walk, leaving the walk state
+    untouched: with a single tenant this collapses to exactly the old
+    per-class FIFO + front-requeue discipline, bit-for-bit. Iteration
+    order (also the snapshot serialization order) is class by class,
+    ring order within, FIFO within a tenant."""
 
-    def __init__(self):
-        self._classes: Dict[int, deque] = {}
+    def __init__(self, weights: Optional[Mapping[str, int]] = None,
+                 quantum: int = 64):
+        self._classes: Dict[int, _ClassQueue] = {}
+        self._weights = dict(weights or {})
+        self._quantum = max(1, int(quantum))
+        self._tenant_depth: Dict[str, int] = {}
 
-    def _first_class(self, below: Optional[int] = None) -> Optional[int]:
-        # every deque in _classes is non-empty (dead classes are
-        # deleted the moment they drain), so this is a pure key scan
-        return min((p for p in self._classes
-                    if below is None or p < below), default=None)
+    @staticmethod
+    def _cost(entry: _QueueEntry) -> int:
+        """The DRR cost of admitting an entry: its committed token
+        budget (what it may make the engine serve). Charged once per
+        request lifetime (``drr_charged``)."""
+        if entry.drr_charged:
+            return 0
+        return len(entry.request.prompt) + entry.request.max_new_tokens
+
+    def _weight(self, tenant: str) -> int:
+        return max(1, int(self._weights.get(tenant, 1)))
+
+    def tenant_depth(self, tenant: str) -> int:
+        """Waiting entries currently held by ``tenant`` (all classes) —
+        the O(1) backing of TenantQuota.max_waiting's door check."""
+        return self._tenant_depth.get(tenant, 0)
+
+    def _classes_ascending(self, below: Optional[int]):
+        for p in sorted(self._classes):
+            if below is not None and p >= below:
+                return
+            yield self._classes[p]
+
+    def _note_removed(self, cq: _ClassQueue, tenant: str) -> None:
+        self._tenant_depth[tenant] -= 1
+        if not self._tenant_depth[tenant]:
+            del self._tenant_depth[tenant]
+        if not cq.queues[tenant]:
+            cq.remove_tenant(tenant)
 
     def append(self, entry: _QueueEntry) -> None:
-        self._classes.setdefault(entry.request.priority,
-                                 deque()).append(entry)
+        self._enqueue(entry, left=False)
 
     def appendleft(self, entry: _QueueEntry) -> None:
-        self._classes.setdefault(entry.request.priority,
-                                 deque()).appendleft(entry)
+        self._enqueue(entry, left=True)
 
-    def head(self, below: Optional[int] = None) -> Optional[_QueueEntry]:
-        """The next admissible entry — most urgent class's front, or
-        None. ``below`` restricts to classes < it (the ladder's
-        admission pause)."""
-        p = self._first_class(below)
-        return None if p is None else self._classes[p][0]
+    def _enqueue(self, entry: _QueueEntry, left: bool) -> None:
+        cq = self._classes.setdefault(entry.request.priority,
+                                      _ClassQueue())
+        t = entry.request.tenant
+        q = cq.queues.get(t)
+        if q is None:
+            q = cq.queues[t] = deque()
+            cq.ring.append(t)           # new tenants join at the tail
+            cq.deficits.setdefault(t, 0.0)
+        (q.appendleft if left else q.append)(entry)
+        self._tenant_depth[t] = self._tenant_depth.get(t, 0) + 1
 
-    def popleft(self, below: Optional[int] = None) -> _QueueEntry:
-        """Pop exactly the entry :meth:`head` (same ``below``) returns."""
-        p = self._first_class(below)
-        if p is None:
-            raise IndexError("pop from an empty waiting queue")
-        entry = self._classes[p].popleft()
-        if not self._classes[p]:
-            # drop drained classes: priority is an arbitrary client
-            # int, and dead deques would make every head()/popleft()
-            # scan (and the dict itself) grow with every distinct
-            # value ever submitted
-            del self._classes[p]
-        return entry
+    def _walk(self, cq: _ClassQueue, skip, mutate: bool):
+        """The next entry the class would admit — ``mutate=False``
+        peeks, ``mutate=True`` pops it and commits the walk. ``skip``
+        tenants are passed over without credit (the engine's per-tick
+        quota hold). Returns None when nothing in the class is
+        servable."""
+        skip = skip or ()
+        n = len(cq.ring)
+        # phase 1: already-charged heads (preemption requeues, restored
+        # residents) serve out of band, ring order from the cursor,
+        # without touching the walk state — the old front-of-the-class
+        # discipline, tenant-aware
+        for k in range(n):
+            t = cq.ring[(cq.cursor + k) % n]
+            if t in skip:
+                continue
+            q = cq.queues[t]
+            if q and q[0].drr_charged:
+                if not mutate:
+                    return q[0]
+                e = q.popleft()
+                self._note_removed(cq, t)
+                return e
+        # phase 2: the weighted DRR walk
+        candidates = [t for t in cq.ring if t not in skip]
+        if not candidates:
+            return None
+        deficits = cq.deficits if mutate else dict(cq.deficits)
+        cursor, credited = cq.cursor, cq.credited
+        # termination bound (bug guard only): a tenant needs at most
+        # ceil(max_cost / quantum) quantum credits, and each credit
+        # costs TWO loop iterations (the credit itself, then the
+        # cursor advance after the affordability re-check fails), per
+        # ring member per cycle — hence the factor 2
+        max_cost = max(self._cost(cq.queues[t][0]) for t in candidates)
+        limit = 2 * len(cq.ring) * (max_cost // self._quantum + 2) + 16
+        for _ in range(limit):
+            t = cq.ring[cursor]
+            if t in skip:
+                cursor = (cursor + 1) % len(cq.ring)
+                credited = False
+                continue
+            head = cq.queues[t][0]
+            cost = self._cost(head)
+            if deficits[t] >= cost:
+                if not mutate:
+                    return head
+                e = cq.queues[t].popleft()
+                deficits[t] -= cost
+                e.drr_charged = True
+                # the cursor STAYS on the serving tenant: DRR serves
+                # while the deficit lasts, then moves on
+                cq.cursor, cq.credited = cursor, credited
+                self._note_removed(cq, t)
+                return e
+            if not credited:
+                deficits[t] += self._quantum * self._weight(t)
+                credited = True
+                continue
+            cursor = (cursor + 1) % len(cq.ring)
+            credited = False
+        raise RuntimeError(
+            "DRR walk failed to terminate — invariant bug "
+            f"(ring={cq.ring}, deficits={deficits})")
+
+    def head(self, below: Optional[int] = None,
+             skip=None) -> Optional[_QueueEntry]:
+        """The next admissible entry, or None. ``below`` restricts to
+        classes < it (the ladder's admission pause); ``skip`` tenants
+        are passed over (quota holds) — a class whose every tenant is
+        skipped falls through to the next class, so one tenant's quota
+        never gates another tenant's lower class."""
+        for cq in self._classes_ascending(below):
+            e = self._walk(cq, skip, mutate=False)
+            if e is not None:
+                return e
+        return None
+
+    def popleft(self, below: Optional[int] = None,
+                skip=None) -> _QueueEntry:
+        """Pop exactly the entry :meth:`head` (same arguments)
+        returns."""
+        for p in sorted(self._classes):
+            if below is not None and p >= below:
+                break
+            cq = self._classes[p]
+            e = self._walk(cq, skip, mutate=True)
+            if e is not None:
+                if not cq.ring:
+                    # drop drained classes: priority is an arbitrary
+                    # client int, and dead entries would grow the scan
+                    # with every distinct value ever submitted
+                    del self._classes[p]
+                return e
+        raise IndexError("pop from an empty waiting queue")
 
     def has_priority_below(self, limit: int) -> bool:
-        return self._first_class(below=limit) is not None
+        return any(True for _ in self._classes_ascending(limit))
 
     def expel(self, pred) -> List[_QueueEntry]:
-        """Remove (and return, in admission order) every entry matching
-        ``pred``, preserving the order of the survivors — the deadline
-        expiry sweep."""
+        """Remove (and return, in iteration order) every entry matching
+        ``pred``, preserving the order of the survivors and the DRR
+        walk state of every surviving tenant — the deadline-expiry and
+        abort sweep."""
         removed: List[_QueueEntry] = []
         for p in sorted(self._classes):
-            q = self._classes[p]
-            kept: deque = deque()
-            while q:
-                e = q.popleft()
-                (removed if pred(e) else kept).append(e)
-            if kept:
-                self._classes[p] = kept
-            else:
-                del self._classes[p]    # same dead-class hygiene
+            cq = self._classes[p]
+            for t in list(cq.ring):
+                q = cq.queues[t]
+                kept: deque = deque()
+                while q:
+                    e = q.popleft()
+                    if pred(e):
+                        removed.append(e)
+                        self._tenant_depth[t] -= 1
+                        if not self._tenant_depth[t]:
+                            del self._tenant_depth[t]
+                    else:
+                        kept.append(e)
+                cq.queues[t] = kept
+                if not kept:
+                    cq.remove_tenant(t)
+            if not cq.ring:
+                del self._classes[p]
         return removed
+
+    def snapshot_state(self) -> Dict[str, object]:
+        """The JSON-able DRR walk state per class: ring order, the
+        cursor tenant, its credited flag, and the deficits. Restoring
+        them (:meth:`restore_state`) resumes the identical admission
+        walk mid-cycle (docs/robustness.md)."""
+        out = {}
+        for p, cq in self._classes.items():
+            out[str(p)] = {
+                "ring": list(cq.ring),
+                "cursor_tenant": (cq.ring[cq.cursor] if cq.ring
+                                  else None),
+                "credited": bool(cq.credited),
+                "deficits": {t: float(d) for t, d in cq.deficits.items()},
+            }
+        return out
+
+    def restore_state(self, state: Mapping[str, object]) -> None:
+        """Re-apply :meth:`snapshot_state` after the queue's entries
+        were re-appended. Tenants present now but absent from the
+        serialized ring (previously-resident requests re-queued by
+        restore) append at the ring tail; serialized tenants no longer
+        present drop out. The cursor re-anchors on its tenant."""
+        for key, rec in (state or {}).items():
+            cq = self._classes.get(int(key))
+            if cq is None:
+                continue
+            serialized = [t for t in rec.get("ring", ()) if t in cq.queues]
+            cq.ring = serialized + [t for t in cq.ring
+                                    if t not in serialized]
+            for t, d in (rec.get("deficits") or {}).items():
+                if t in cq.queues:
+                    cq.deficits[t] = float(d)
+            cur = rec.get("cursor_tenant")
+            if cur in cq.ring:
+                cq.cursor = cq.ring.index(cur)
+                cq.credited = bool(rec.get("credited", False))
+            else:
+                cq.cursor, cq.credited = 0, False
 
     def __iter__(self):
         for p in sorted(self._classes):
-            yield from self._classes[p]
+            cq = self._classes[p]
+            for t in cq.ring:
+                yield from cq.queues[t]
 
     def __len__(self) -> int:
-        return sum(len(q) for q in self._classes.values())
+        return sum(self._tenant_depth.values())
+
 
 
 @dataclasses.dataclass
@@ -583,7 +952,8 @@ class InferenceEngine:
             dtype=config.kv_dtype)
         self.allocator = BlockAllocator(config.num_blocks)
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
-        self.waiting = _WaitingQueue()
+        self.waiting = _WaitingQueue(weights=config.tenant_weights,
+                                     quantum=config.drr_quantum)
         # every uid currently waiting or resident — the O(1) backing of
         # add_request's duplicate guard (maintained at enqueue/restore,
         # cleared by _set_status at every terminal transition)
@@ -639,6 +1009,32 @@ class InferenceEngine:
         self._num_degrade_steps_down = 0
         self._num_degrade_steps_up = 0
         self._num_degrade_flushed_blocks = 0
+        # -- multi-tenant isolation (docs/robustness.md) ---------------
+        self._num_throttled = 0
+        self._num_cancelled = 0
+        # the tenant ledger: every tenant ever submitted to this
+        # engine, its delivered-token count, its exponentially-decayed
+        # token-rate estimator (value + last-update time), its
+        # terminal-status tallies, and its quota-preemption count
+        self._tenant_seen: set = {DEFAULT_TENANT}
+        self._tenant_tokens: Dict[str, int] = {}
+        self._tenant_rate: Dict[str, float] = {}
+        self._tenant_rate_t: Dict[str, float] = {}
+        self._tenant_status: Dict[str, Dict[str, int]] = {}
+        self._tenant_preemptions: Dict[str, int] = {}
+        # streaming delivery (docs/serving.md): (uid, token, is_last)
+        # events appended as tokens become host-visible, drained by
+        # pop_stream_events(); every terminal transition appends a
+        # (uid, -1, True) sentinel
+        self._stream: deque = deque()
+        # dynamic speculation (spec_adapt): the adaptive per-plan draft
+        # cap, the acceptance-rate EWMA driving it, and the probe
+        # countdown that lets a capped-out engine re-measure
+        self._spec_cap = config.spec_tokens
+        self._spec_accept_ewma: Optional[float] = None
+        self._spec_probe_countdown = _SPEC_PROBE_EVERY
+        self._num_spec_cap_shrinks = 0
+        self._num_spec_cap_restores = 0
         self._fetch_failures = 0   # consecutive failed deferred drains
         # the in-flight decode dispatch: (device [B, K] tokens, device
         # [B] counts, the lane indices it covers). Fetched — the only
@@ -819,6 +1215,10 @@ class InferenceEngine:
             raise ValueError(
                 f"request {request.uid!r}: priority must be >= 0 "
                 f"(got {request.priority}); 0 is the most urgent class")
+        if not isinstance(request.tenant, str) or not request.tenant:
+            raise ValueError(
+                f"request {request.uid!r}: tenant must be a non-empty "
+                f"string (got {request.tenant!r})")
         request.sampling.validate()
         # a uid that is still waiting or resident would collide in the
         # uid-keyed deadline map and the engine-owned status field —
@@ -842,6 +1242,18 @@ class InferenceEngine:
         # reads status None, not a stale verdict from its previous
         # lifecycle (the documented "no status" contract)
         object.__setattr__(request, "status", None)
+        self._tenant_seen.add(request.tenant)
+        # tenant quotas first (the shed is the TENANT's own doing and
+        # is charged to its ledger with a real terminal verdict —
+        # docs/robustness.md, isolation), then the engine-wide bound
+        reason = self._door_throttle_reason(request)
+        if reason is not None:
+            self.finished[uid] = []
+            self._set_status(request, "throttled")
+            self._num_throttled += 1
+            raise TenantThrottledError(
+                f"request {uid!r} throttled: tenant "
+                f"{request.tenant!r} {reason}")
         # backpressure: the bounded queue is the overload contract —
         # callers get an explicit shed signal, not unbounded growth
         if (self.config.max_waiting is not None
@@ -863,15 +1275,133 @@ class InferenceEngine:
 
     def try_add(self, request: Request) -> bool:
         """Non-raising backpressure variant of :meth:`add_request`:
-        returns False when the bounded queue sheds the request (and
-        counts it), True when enqueued. Validation errors — bad
-        geometry, duplicate uid — still raise: those are caller bugs,
-        not load."""
+        returns False when the bounded queue or the tenant's quota
+        sheds the request (and counts it; a quota shed additionally
+        leaves terminal status ``"throttled"``), True when enqueued.
+        Validation errors — bad geometry, duplicate uid — still raise:
+        those are caller bugs, not load."""
         try:
             self.add_request(request)
-        except QueueFullError:
+        except (QueueFullError, TenantThrottledError):
             return False
         return True
+
+    # -- the tenant ledger (docs/robustness.md, isolation) -----------------
+
+    def _tenant_quota(self, tenant: str) -> Optional[TenantQuota]:
+        quotas = self.config.tenant_quotas
+        return None if quotas is None else quotas.get(tenant)
+
+    def _tenant_rate_now(self, tenant: str) -> float:
+        """The tenant's token-rate estimate decayed to now (read-only:
+        delivery updates happen in :meth:`_note_tenant_tokens`)."""
+        r = self._tenant_rate.get(tenant, 0.0)
+        if r == 0.0:
+            return 0.0
+        dt = max(0.0, self._clock() - self._tenant_rate_t[tenant])
+        return r * math.exp(-dt / self.config.tenant_rate_tau_s)
+
+    def _note_tenant_tokens(self, tenant: str, n: int) -> None:
+        """Account ``n`` delivered tokens to the tenant: the running
+        total, and the exponentially-decayed rate estimator the
+        ``tokens_per_s`` quota reads (each token adds ``1/tau``, so a
+        constant rate R settles the estimator at R)."""
+        self._tenant_tokens[tenant] = \
+            self._tenant_tokens.get(tenant, 0) + n
+        now = self._clock()
+        tau = self.config.tenant_rate_tau_s
+        r = self._tenant_rate.get(tenant, 0.0)
+        if r:
+            dt = max(0.0, now - self._tenant_rate_t[tenant])
+            r *= math.exp(-dt / tau)
+        self._tenant_rate[tenant] = r + n / tau
+        self._tenant_rate_t[tenant] = now
+
+    def _door_throttle_reason(self, request: Request) -> Optional[str]:
+        """The tenant-quota door check: the reason this submission is
+        over quota, or None. Checked BEFORE the request touches the
+        queue, the deadline map, or the pool — an over-quota request
+        burns nothing."""
+        q = self._tenant_quota(request.tenant)
+        if q is None:
+            return None
+        if q.max_resident_blocks is not None:
+            worst = blocks_needed(
+                len(request.prompt) + request.max_new_tokens,
+                self.config.block_size)
+            if worst > q.max_resident_blocks:
+                return (f"needs up to {worst} blocks but is capped at "
+                        f"max_resident_blocks={q.max_resident_blocks} "
+                        f"(it could never run)")
+        if (q.max_waiting is not None
+                and self.waiting.tenant_depth(request.tenant)
+                >= q.max_waiting):
+            return (f"already holds {q.max_waiting} waiting entries "
+                    f"(max_waiting)")
+        if q.tokens_per_s is not None:
+            rate = self._tenant_rate_now(request.tenant)
+            if rate > q.tokens_per_s:
+                return (f"is over its token-rate budget "
+                        f"({rate:.1f} > {q.tokens_per_s} tokens/s)")
+        return None
+
+    def _tenant_has_resident(self, tenant: str) -> bool:
+        return any(s is not None and s.request.tenant == tenant
+                   for s in self.slots)
+
+    # -- client lifecycle: cancellation + streaming (docs/serving.md) ------
+
+    def abort(self, uid: str) -> bool:
+        """Cancel a WAITING or RESIDENT request: every resource it
+        holds is reclaimed now — queue entry removed (DRR walk state
+        of the surviving tenants untouched), or its lane freed with
+        blocks released via the usual deepest-first discipline — and
+        it reaches terminal status ``"cancelled"`` carrying the tokens
+        it already emitted. A disconnect callback maps straight onto
+        this. Returns False for a uid the engine does not currently
+        own (unknown, already terminal, or already drained).
+
+        Safe against the in-flight decode dispatch: the pending drain
+        matches lanes by the uid they held AT DISPATCH and discards
+        results for an aborted (or re-filled) lane; any K/V the
+        dispatch wrote into the freed blocks sits past every live
+        sequence's position masks until the blocks' next owner
+        overwrites it — the same argument that makes speculative
+        rollback and trimmed reservations safe.
+        ``check_allocator_integrity`` certifies the reclamation after
+        chaos runs mixing aborts with faults and preemptions."""
+        if uid not in self._live_uids:
+            return False
+        removed = self.waiting.expel(lambda e: e.request.uid == uid)
+        if removed:
+            entry = removed[0]
+            self.finished[uid] = list(entry.generated)
+            self._set_status(entry.request, "cancelled")
+            self._num_cancelled += 1
+            return True
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.request.uid == uid:
+                self._finish(i, status="cancelled")
+                self._num_cancelled += 1
+                return True
+        return False    # unreachable while _live_uids is consistent
+
+    def pop_stream_events(self) -> List[Tuple[str, int, bool]]:
+        """Drain the streaming buffer: ``(uid, token, is_last)`` events
+        in emission order, appended as tokens become host-visible (the
+        prefill's first token at its fetch, decode tokens at the
+        deferred drain) — callers consume tokens as they stream
+        instead of waiting on terminal ``run()`` results. Every
+        terminal transition — finish, timeout, failure, rejection,
+        throttle, cancellation — appends a ``(uid, -1, True)``
+        sentinel (the device's -1 "no token" convention), so a
+        consumer learns each request's end exactly once; queue-full
+        door sheds never entered the engine and emit nothing. The
+        buffer grows until popped — a streaming caller should drain it
+        every few ticks."""
+        out = list(self._stream)
+        self._stream.clear()
+        return out
 
     def _request_key(self, entry: _QueueEntry):
         """The request's own PRNG key: engine seed x arrival order.
@@ -943,13 +1473,53 @@ class InferenceEngine:
 
     def _set_status(self, request: Request, status: str) -> None:
         """Record a terminal status: in the drain-able ``statuses`` map,
-        on the request object itself, and out of the deadline watch and
-        the live-uid set (every terminal transition funnels through
-        here — the uid is re-usable from this point)."""
+        on the request object itself, out of the deadline watch and
+        the live-uid set, into the tenant's status tally, and onto the
+        stream as the ``(uid, -1, True)`` terminal sentinel (every
+        terminal transition funnels through here — the uid is
+        re-usable from this point, and stream consumers learn
+        terminality exactly once)."""
         self.statuses[request.uid] = status
         object.__setattr__(request, "status", status)
         self._deadline.pop(request.uid, None)
         self._live_uids.discard(request.uid)
+        tally = self._tenant_status.setdefault(request.tenant, {})
+        tally[status] = tally.get(status, 0) + 1
+        self._stream.append((request.uid, -1, True))
+        self._prune_tenant_if_idle(request.tenant)
+
+    def _tenant_is_listed(self, tenant: str) -> bool:
+        """Tenants named in the config (weights or quotas) plus the
+        default tenant keep permanent ledger rows."""
+        return (tenant == DEFAULT_TENANT
+                or tenant in (self.config.tenant_weights or {})
+                or tenant in (self.config.tenant_quotas or {}))
+
+    def _prune_tenant_if_idle(self, tenant: str) -> None:
+        """Drop an UNLISTED tenant's ledger state once it has no
+        waiting or resident footprint: ``tenant`` is a free-form
+        client string, and a hostile (or buggy) client minting a fresh
+        id per request would otherwise grow five per-tenant maps — and
+        every snapshot and ``stats()`` call — without bound, in the
+        engine whose whole point is surviving hostile tenants (the
+        same hygiene the waiting queue applies to dead priority
+        classes). The cost: an ephemeral tenant's token/status tallies
+        and rate estimator reset once it drains — list a tenant in
+        ``tenant_weights``/``tenant_quotas`` to make its row (and its
+        rate budget) permanent. Allocator-side attribution (cached
+        blocks, evictions) is untouched and still surfaces its row in
+        ``stats()["tenants"]`` while any footprint remains."""
+        if self._tenant_is_listed(tenant):
+            return
+        if (self.waiting.tenant_depth(tenant)
+                or self._tenant_has_resident(tenant)):
+            return
+        self._tenant_seen.discard(tenant)
+        self._tenant_tokens.pop(tenant, None)
+        self._tenant_rate.pop(tenant, None)
+        self._tenant_rate_t.pop(tenant, None)
+        self._tenant_status.pop(tenant, None)
+        self._tenant_preemptions.pop(tenant, None)
 
     def _yield_key(self, idx: int):
         """Victim-selection order for preemption and decode quarantine-
@@ -980,10 +1550,14 @@ class InferenceEngine:
         terminal outcome ("finished", or "timeout" for a deadline
         expiry mid-generation — the tokens emitted so far are kept)."""
         slot = self.slots[idx]
-        self.allocator.free(list(reversed(slot.blocks)))
+        self.allocator.free(list(reversed(slot.blocks)),
+                            tenant=slot.request.tenant)
         self.finished[slot.request.uid] = self._resume_tokens(slot)
-        self._set_status(slot.request, status)
+        # clear the lane BEFORE the terminal transition: _set_status's
+        # idle-tenant pruning must not see the finishing slot as a
+        # live resident
         self.slots[idx] = None
+        self._set_status(slot.request, status)
         self._invalidate_lanes()
 
     def _quarantine_slot(self, idx: int) -> None:
@@ -1045,7 +1619,8 @@ class InferenceEngine:
             self.waiting.appendleft(_QueueEntry(
                 request=slot.request, arrival=slot.entry.arrival,
                 generated=self._resume_tokens(slot),
-                enq_t=self._clock(), enq_tick=self._num_ticks))
+                enq_t=self._clock(), enq_tick=self._num_ticks,
+                drr_charged=True))
             self.slots[i] = None
         # requeues are the one path that pushes the queue past
         # max_waiting (by at most max_batch) — the exact overshoot the
@@ -1088,11 +1663,16 @@ class InferenceEngine:
             + _EWMA_ALPHA * dt
 
     def _record_token(self, idx: int, token: int) -> None:
-        """Append a sampled token to a slot, finishing on EOS/max-len."""
+        """Append a sampled token to a slot, finishing on EOS/max-len.
+        The single funnel for FRESH tokens (resumed histories bypass
+        it), so it also feeds the stream-event buffer and the tenant's
+        delivered-token ledger exactly once per token."""
         slot = self.slots[idx]
         slot.generated.append(token)
         slot.last_token = token
         req = slot.request
+        self._stream.append((req.uid, int(token), False))
+        self._note_tenant_tokens(req.tenant, 1)
         if ((req.eos_token_id is not None and token == req.eos_token_id)
                 or len(slot.generated) >= req.max_new_tokens):
             self._finish(idx)
@@ -1121,7 +1701,8 @@ class InferenceEngine:
                 slot.block_hashes.append(hash_block_tokens(
                     prev, slot.tokens[j * bs: (j + 1) * bs]))
             self.allocator.register_prefix(slot.block_hashes[j],
-                                           slot.blocks[j])
+                                           slot.blocks[j],
+                                           tenant=slot.request.tenant)
             slot.num_registered += 1
 
     # -- admission (optimistic: current need, not worst case) --------------
@@ -1174,7 +1755,8 @@ class InferenceEngine:
 
     def _shed_if_infeasible(self, entry: _QueueEntry,
                             uncached_tail: int,
-                            below: Optional[int]) -> bool:
+                            below: Optional[int],
+                            skip=None) -> bool:
         """The admit-time feasibility gate: a deadline that cannot
         cover even the contention-free service estimate is shed NOW,
         with status ``"rejected"`` — before the request burns pool
@@ -1198,7 +1780,7 @@ class InferenceEngine:
             skips_prefill=bool(entry.generated) and uncached_tail <= 0)
         if est is None or self._clock() + est <= dl:
             return False
-        self.waiting.popleft(below=below)    # exactly this entry
+        self.waiting.popleft(below=below, skip=skip)  # exactly this entry
         self.finished[req.uid] = list(entry.generated)
         self._set_status(req, "rejected")
         self._num_rejected_infeasible += 1
@@ -1224,22 +1806,27 @@ class InferenceEngine:
         the need smaller still: the longest cached block-aligned prefix
         is shared by reference, and only the tail is prefilled.
 
-        Candidates are considered in ``(priority, arrival)`` order
-        (:class:`_WaitingQueue`); an infeasible-deadline head is shed
-        by the gate and the next candidate considered, while a head
-        that merely does not FIT blocks everything behind it
-        (head-of-line blocking — no starvation WITHIN a class; across
-        classes the strict priority order is the design: sustained
-        higher-class load starves lower classes, bounded only by their
-        deadlines)."""
+        Candidates are considered class by class, weighted-DRR across
+        tenants within a class (:class:`_WaitingQueue`); an
+        infeasible-deadline head is shed by the gate and the next
+        candidate considered; a head whose TENANT is over its
+        resident-block quota is held back (the tenant joins this
+        pass's ``skip`` set — other tenants flow past it, so one
+        tenant's quota never blocks another's admission), while a head
+        that merely does not FIT the pool blocks everything behind it
+        (head-of-line blocking — no starvation WITHIN a (class,
+        tenant) lane; across classes the strict priority order is the
+        design: sustained higher-class load starves lower classes,
+        bounded only by their deadlines)."""
         bs = self.config.block_size
         admitted = 0
         below = self._admission_priority_limit()
+        skip: set = set()
         for idx in range(self.config.max_batch):
             if self.slots[idx] is not None:
                 continue
             while True:
-                entry = self.waiting.head(below=below)
+                entry = self.waiting.head(below=below, skip=skip)
                 if entry is None:
                     return admitted
                 seq = list(entry.request.prompt)
@@ -1254,7 +1841,7 @@ class InferenceEngine:
                     hashes = entry.hashes
                     matched = self.allocator.lookup_prefix(hashes)
                 m_tok = len(matched) * bs
-                if self._shed_if_infeasible(entry, L - m_tok, below):
+                if self._shed_if_infeasible(entry, L - m_tok, below, skip):
                     continue    # gate shed the head; try the next one
                 tail = blocks_needed(L, bs) - len(matched)
                 # current need = blocks through the FIRST decode write
@@ -1263,6 +1850,33 @@ class InferenceEngine:
                 # exact-fit request whose whole generation lives in the
                 # last partial block needs no headroom at all
                 need = blocks_needed(L + 1, bs) - len(matched)
+                # per-tenant block quota: would this admission push the
+                # tenant's fractional resident charge over its cap?
+                # (new private blocks charge 1 each; acquiring a
+                # matched block adds a 1/(refs + 1) share)
+                tenant = entry.request.tenant
+                q = self._tenant_quota(tenant)
+                if q is not None and q.max_resident_blocks is not None:
+                    extra = need + sum(
+                        1.0 / (self.allocator.refcount(b) + 1)
+                        for b in matched)
+                    if (self.allocator.tenant_charge(tenant) + extra
+                            > q.max_resident_blocks + 1e-9):
+                        if not self._tenant_has_resident(tenant):
+                            # nothing of this tenant's will ever free a
+                            # block — shed instead of wedging its lane
+                            # (unreachable for door-validated requests,
+                            # kept as the no-deadlock backstop)
+                            self.waiting.popleft(below=below, skip=skip)
+                            self.finished[entry.request.uid] = \
+                                list(entry.generated)
+                            self._set_status(entry.request, "throttled")
+                            self._num_throttled += 1
+                            continue
+                        # hold the TENANT, not the queue: its own lanes
+                        # must drain first; other tenants flow past
+                        skip.add(tenant)
+                        continue
                 # matched blocks that are currently cached (refcount 0)
                 # stop being evictable once we take them, so they don't
                 # count toward the capacity the tail can draw from
@@ -1273,10 +1887,11 @@ class InferenceEngine:
                     # head-of-line blocking: don't let a small request
                     # starve the head
                     return admitted
-                self.allocator.acquire(matched)
-                self.waiting.popleft(below=below)
+                self.allocator.acquire(matched, tenant=tenant)
+                self.waiting.popleft(below=below, skip=skip)
                 self._note_admitted_wait(entry)
-                blocks = matched + (self.allocator.alloc(tail)
+                blocks = matched + (self.allocator.alloc(tail,
+                                                         tenant=tenant)
                                     if tail else [])
                 self._prefix_lookup_blocks += len(hashes)
                 self._prefix_hit_blocks += len(matched)
@@ -1425,6 +2040,18 @@ class InferenceEngine:
             # but REVERSIBLE: plans resume when pressure clears
             return
         S = self.config.spec_tokens
+        if self.config.spec_adapt:
+            S = min(S, self._spec_cap)
+            if S == 0:
+                # capped out: every _SPEC_PROBE_EVERY-th plan runs a
+                # 1-token probe so acceptance is re-measured and the
+                # cap can climb back (otherwise no observations ever
+                # arrive and the degrade is permanent)
+                self._spec_probe_countdown -= 1
+                if self._spec_probe_countdown > 0:
+                    return
+                self._spec_probe_countdown = _SPEC_PROBE_EVERY
+                S = 1
         vocab = self.model.cfg.vocab_size
         plan: Dict[int, List[int]] = {}
 
@@ -1481,15 +2108,53 @@ class InferenceEngine:
         if len(cand) <= 1:
             return False
         idx = max(cand, key=self._yield_key)
+        return self._preempt_slot(idx)
+
+    def _preempt_tenant_lane(self, tenant: str, requester: int) -> bool:
+        """Quota-driven preemption: a lane growing past its TENANT's
+        ``max_resident_blocks`` evicts the tenant's OWN lowest-class,
+        youngest other lane — the tenant pays for its growth out of its
+        own residency, never another tenant's. Only lanes whose release
+        can actually LOWER the tenant's fractional charge are
+        candidates: a lane holds such charge iff it owns a block
+        privately (refcount 1 — freeing returns a whole unit) or a
+        block some OTHER tenant co-holds (freeing shrinks this
+        tenant's fraction). A sibling whose every block is fully
+        shared within the tenant contributes nothing reclaimable —
+        freeing it just re-concentrates the same charge — so evicting
+        it would churn lanes without relieving the quota. False when
+        no reducing candidate exists (growth proceeds: residency is
+        then bounded by lane count x the door-validated worst case)."""
+        alloc = self.allocator
+
+        def reduces(slot: "_Slot") -> bool:
+            return any(alloc.refcount(b) == 1
+                       or alloc.tenant_refcount(b, tenant)
+                       < alloc.refcount(b)
+                       for b in slot.blocks)
+
+        cand = [i for i, s in enumerate(self.slots)
+                if s is not None and i != requester
+                and s.request.tenant == tenant and reduces(s)]
+        if not cand:
+            return False
+        idx = max(cand, key=self._yield_key)
+        tally = self._tenant_preemptions
+        tally[tenant] = tally.get(tenant, 0) + 1
+        return self._preempt_slot(idx)
+
+    def _preempt_slot(self, idx: int) -> bool:
         slot = self.slots[idx]
         gen = self._resume_tokens(slot)
         # deepest-first, same as _finish: keep evictable chains matchable
-        self.allocator.free(list(reversed(slot.blocks)))
+        self.allocator.free(list(reversed(slot.blocks)),
+                            tenant=slot.request.tenant)
         self.waiting.appendleft(_QueueEntry(request=slot.request,
                                             arrival=slot.entry.arrival,
                                             generated=gen,
                                             enq_t=self._clock(),
-                                            enq_tick=self._num_ticks))
+                                            enq_tick=self._num_ticks,
+                                            drr_charged=True))
         # sample the peak at the requeue itself — admission may
         # re-absorb the entry before step()'s end-of-tick sample
         self._queue_depth_peak = max(self._queue_depth_peak,
@@ -1532,9 +2197,24 @@ class InferenceEngine:
                                - len(slot.generated))
                 need = blocks_needed(slot.context_len + span, bs)
                 if len(slot.blocks) < need:
+                    grow = need - len(slot.blocks)
+                    tenant = slot.request.tenant
+                    q = self._tenant_quota(tenant)
+                    if (q is not None
+                            and q.max_resident_blocks is not None
+                            and self.allocator.tenant_charge(tenant)
+                            + grow > q.max_resident_blocks + 1e-9
+                            and self._preempt_tenant_lane(tenant, i)):
+                        # over quota: the tenant paid with its own
+                        # youngest lane — re-check (the freed charge
+                        # usually covers the growth). When no other
+                        # lane of the tenant exists, growth proceeds:
+                        # a single lane's private worst case fits the
+                        # quota by the door bound.
+                        continue
                     try:
                         slot.blocks.extend(
-                            self.allocator.alloc(need - len(slot.blocks)))
+                            self.allocator.alloc(grow, tenant=tenant))
                         self._invalidate_tables()
                     except CacheOutOfBlocks:
                         if not self._preempt_for(i):
@@ -1553,7 +2233,11 @@ class InferenceEngine:
                 if j is None:
                     break
                 try:
-                    nb = self.allocator.alloc(1)[0]
+                    # CoW rides outside the tenant quota check: it nets
+                    # +1 - (shared fraction) charge, bounded by the
+                    # same door-validated worst case
+                    nb = self.allocator.alloc(
+                        1, tenant=slot.request.tenant)[0]
                 except CacheOutOfBlocks:
                     if not self._preempt_for(i):
                         raise CacheOutOfBlocks(
@@ -1564,7 +2248,7 @@ class InferenceEngine:
                 b = slot.blocks[j]
                 self.cache = self._cow(self.cache,
                                        jnp.int32(b), jnp.int32(nb))
-                self.allocator.free([b])
+                self.allocator.free([b], tenant=slot.request.tenant)
                 slot.blocks[j] = nb
                 self._invalidate_tables()
                 # the copy diverges from the indexed contents the
@@ -1644,7 +2328,13 @@ class InferenceEngine:
                 # proposals that preemption or a failed dispatch
                 # dropped before any verification could accept them
                 self._num_draft_tokens += int(dlens.sum())
-            self._pending = (toks, list(active))
+            # the uid each covered lane held at dispatch: the drain
+            # discards results for lanes whose request was aborted (or
+            # whose lane was re-filled) while the dispatch was in
+            # flight — matching on uid, not lane index
+            self._pending = (toks, list(active),
+                             {i: self.slots[i].request.uid
+                              for i in active})
             return
 
     def _drain_decode(self) -> bool:
@@ -1670,7 +2360,7 @@ class InferenceEngine:
         covered lane before the reset."""
         if self._pending is None:
             return False
-        toks, active = self._pending
+        toks, active, uids = self._pending
         self._pending = None
         # the decode EWMA times THIS fetch block only — the remaining
         # in-flight device time at drain. The full launch->drain span
@@ -1692,7 +2382,11 @@ class InferenceEngine:
                 # so serving/training retry counters stay comparable
                 live = [i for i in active
                         if self.slots[i] is not None
-                        and self.slots[i].started]
+                        and self.slots[i].started
+                        # a lane aborted (and possibly re-filled)
+                        # mid-flight was no part of the failed
+                        # dispatch: never quarantine its new owner
+                        and self.slots[i].request.uid == uids[i]]
                 if live:
                     idx = max(live, key=self._yield_key)
                     self._quarantine_slot(idx)
@@ -1712,8 +2406,17 @@ class InferenceEngine:
         counts = (toks >= 0).sum(axis=1)
         spec = self.config.spec_tokens > 0
         bs = self.config.block_size
+        drafted_this = accepted_this = 0
         for i in active:
             slot = self.slots[i]
+            if slot is None or slot.request.uid != uids[i]:
+                # the lane's request was aborted (and the lane possibly
+                # re-filled by admission) while this dispatch was in
+                # flight: its results are DISCARDED — the blocks were
+                # already reclaimed, and any K/V the dispatch wrote to
+                # them sits past every live sequence's masks until
+                # overwritten (docs/serving.md, cancellation)
+                continue
             n = int(counts[i])
             for j in range(n):
                 slot.tokens.append(slot.last_token)   # its K/V landed
@@ -1731,10 +2434,12 @@ class InferenceEngine:
             # greedy rejection means argmax != draft, so a match can
             # only be an acceptance; the bonus sits past the plan)
             prop = self._draft_plan.get(i, ())
+            drafted_this += len(prop)
             for j in range(min(n, len(prop))):
                 if int(toks[i, j]) != prop[j]:
                     break
                 self._num_accepted_tokens += 1
+                accepted_this += 1
             # reservation rollback: the span was reserved for EVERY
             # proposal's write, but rejection advanced the context by
             # less — blocks holding only unaccepted K/V go back to the
@@ -1746,8 +2451,8 @@ class InferenceEngine:
                 keep = blocks_needed(slot.context_len, bs)
                 if len(slot.blocks) > keep:
                     trimmed = len(slot.blocks) - keep
-                    slot.blocks = self.allocator.trim_to(slot.blocks,
-                                                         keep)
+                    slot.blocks = self.allocator.trim_to(
+                        slot.blocks, keep, tenant=slot.request.tenant)
                     self._num_spec_blocks_rolled_back += trimmed
                     # deliberately NO table invalidation: the trimmed
                     # entries sit past blocks_needed(context_len), so
@@ -1761,6 +2466,25 @@ class InferenceEngine:
                     # reservations would let a low-acceptance engine
                     # squat on spec_tokens-worth of blocks per lane,
                     # changing admission/preemption under tight pools.)
+        if spec and self.config.spec_adapt and drafted_this:
+            # dynamic speculation (docs/serving.md): the acceptance
+            # EWMA walks the per-plan draft cap one step per
+            # observation — below spec_accept_low shrink toward 0
+            # (riding the rung-1 empty-plan machinery), above
+            # spec_accept_high restore toward spec_tokens; the dead
+            # band between them is the hysteresis. While acceptance
+            # stays >= high the cap never moves, so the engine is
+            # bit-identical to static speculation.
+            self._spec_accept_ewma = self._ewma_update(
+                self._spec_accept_ewma, accepted_this / drafted_this)
+            if (self._spec_accept_ewma < self.config.spec_accept_low
+                    and self._spec_cap > 0):
+                self._spec_cap -= 1
+                self._num_spec_cap_shrinks += 1
+            elif (self._spec_accept_ewma > self.config.spec_accept_high
+                    and self._spec_cap < self.config.spec_tokens):
+                self._spec_cap += 1
+                self._num_spec_cap_restores += 1
         return True
 
     # -- the degradation ladder (docs/robustness.md) -----------------------
@@ -1923,7 +2647,7 @@ class InferenceEngine:
         generated_token_ids}`` — or, with ``return_status=True``,
         ``{uid: RequestResult(tokens, status)}`` where ``status`` is
         ``"finished"`` | ``"timeout"`` | ``"failed"`` | ``"rejected"``
-        (the result
+        | ``"throttled"`` | ``"cancelled"`` (the result
         contract in docs/serving.md; the same status is written onto
         each ``Request.status``). If a full step makes no progress
         while work remains, raises :class:`EngineStalledError` with
@@ -1935,6 +2659,13 @@ class InferenceEngine:
                     self.stats())
         out, self.finished = self.finished, {}
         statuses, self.statuses = self.statuses, {}
+        # run() IS the non-streaming consumption path: the terminal
+        # result dict it returns supersedes any unconsumed stream
+        # events, so drop them — otherwise every run()-based caller
+        # (which never calls pop_stream_events) leaks one buffered
+        # event per token for the engine's lifetime. Streaming callers
+        # drain via pop_stream_events BEFORE the terminal run().
+        self._stream.clear()
         if return_status:
             return {uid: RequestResult(tokens=toks,
                                        status=statuses.get(uid, "finished"))
@@ -1962,7 +2693,18 @@ class InferenceEngine:
         for knob in ("max_dispatch_retries", "retry_backoff_s",
                      "max_waiting", "queue_high_watermark",
                      "free_block_low_watermark", "degrade_patience",
-                     "degrade_admit_priority"):
+                     "degrade_admit_priority",
+                     # the tenancy knobs are operational in the same
+                     # sense: restoring into a replica with different
+                     # weights or quotas is the incident-recovery move,
+                     # and outputs are arrival-keyed (tenant-invariant)
+                     "tenant_weights", "tenant_quotas", "drr_quantum",
+                     "tenant_rate_tau_s",
+                     # spec_adapt changes SCHEDULE (span boundaries),
+                     # not identity; its cap state rides the overload
+                     # section with the same config-guard as the ladder
+                     "spec_adapt", "spec_accept_low",
+                     "spec_accept_high"):
             d.pop(knob, None)
         return d
 
@@ -1979,6 +2721,8 @@ class InferenceEngine:
                          "top_p": float(req.sampling.top_p)},
             "arrival": int(entry.arrival),
             "priority": int(req.priority),
+            "tenant": str(req.tenant),
+            "drr_charged": bool(entry.drr_charged),
             "generated": [int(t) for t in entry.generated],
         }
         dl = self._deadline.get(req.uid)
@@ -2012,7 +2756,11 @@ class InferenceEngine:
             slot = self.slots[i]
             requests.append(self._entry_record(
                 _QueueEntry(request=slot.request, arrival=slot.entry.arrival,
-                            generated=self._resume_tokens(slot)), now))
+                            generated=self._resume_tokens(slot),
+                            # a resident's DRR cost was paid at its
+                            # admission: restore re-admits it free,
+                            # leaving the serialized walk untouched
+                            drr_charged=True), now))
         for entry in self.waiting:
             requests.append(self._entry_record(entry, now))
         self._num_snapshots += 1
@@ -2044,6 +2792,30 @@ class InferenceEngine:
                 "clear_streak": int(self._clear_streak),
                 "ewma_prefill_s": self._ewma_prefill_s,
                 "ewma_decode_s": self._ewma_decode_s,
+                # the dynamic-speculation refinement rides here too: a
+                # restored engine resumes the same cap walk (sampled
+                # lanes' realized draws depend on span boundaries, so
+                # silently resetting the cap would break restore
+                # bit-identity under spec_adapt)
+                "spec_cap": int(self._spec_cap),
+                "spec_accept_ewma": self._spec_accept_ewma,
+                "spec_probe_countdown": int(self._spec_probe_countdown),
+            },
+            # the tenant ledger: DRR walk state per class (ring order
+            # is implied by the requests' serialization order), the
+            # token-rate estimators (ages re-anchor on the restoring
+            # clock, like deadlines), and the observability tallies
+            "tenancy": {
+                "classes": self.waiting.snapshot_state(),
+                "rates": {t: {"rate": float(r),
+                              "age_s": float(now - self._tenant_rate_t[t])}
+                          for t, r in self._tenant_rate.items()},
+                "tokens": {t: int(n)
+                           for t, n in self._tenant_tokens.items()},
+                "status_counts": {t: dict(c) for t, c in
+                                  self._tenant_status.items()},
+                "preemptions": dict(self._tenant_preemptions),
+                "seen": sorted(self._tenant_seen),
             },
             "block_tables": {
                 self.slots[i].request.uid: [int(b) for b in
@@ -2088,15 +2860,18 @@ class InferenceEngine:
                     top_p=rec["sampling"]["top_p"]),
                 eos_token_id=rec.get("eos_token_id"),
                 deadline_s=deadline,
-                priority=int(rec.get("priority", 0)))
+                priority=int(rec.get("priority", 0)),
+                tenant=str(rec.get("tenant", DEFAULT_TENANT)))
             if deadline is not None:
                 # an already-blown deadline stays blown (<= now)
                 self._deadline[req.uid] = now + deadline
             self._live_uids.add(req.uid)
+            self._tenant_seen.add(req.tenant)
             self.waiting.append(_QueueEntry(
                 request=req, arrival=int(rec["arrival"]),
                 generated=[int(t) for t in rec["generated"]],
-                enq_t=now, enq_tick=self._num_ticks))
+                enq_t=now, enq_tick=self._num_ticks,
+                drr_charged=bool(rec.get("drr_charged", False))))
         self._arrival_count = int(snap["arrival_count"])
         self.finished.update({uid: [int(t) for t in toks]
                               for uid, toks in snap["finished"].items()})
@@ -2133,6 +2908,35 @@ class InferenceEngine:
             v = overload.get(key)
             if v is not None:
                 setattr(self, attr, float(v))
+        # the dynamic-speculation cap resumes its walk ONLY when this
+        # engine adapts too (same guard shape as the ladder rung: a
+        # non-adapting engine could never restore the cap, leaving
+        # speculation degraded forever)
+        if self.config.spec_adapt:
+            self._spec_cap = int(overload.get("spec_cap",
+                                              self.config.spec_tokens))
+            ewma = overload.get("spec_accept_ewma")
+            if ewma is not None:
+                self._spec_accept_ewma = float(ewma)
+            self._spec_probe_countdown = int(
+                overload.get("spec_probe_countdown", _SPEC_PROBE_EVERY))
+        # the tenant ledger: DRR walk state re-anchors after the
+        # re-appends above (serialized ring order wins; restored
+        # residents' tenants join at ring tails), rate estimators
+        # re-anchor their ages on this clock, tallies carry over
+        tenancy = snap.get("tenancy", {})
+        self.waiting.restore_state(tenancy.get("classes", {}))
+        for t, rec in (tenancy.get("rates") or {}).items():
+            self._tenant_rate[t] = float(rec["rate"])
+            self._tenant_rate_t[t] = now - max(0.0, float(rec["age_s"]))
+        for t, n in (tenancy.get("tokens") or {}).items():
+            self._tenant_tokens[t] = int(n)
+        for t, counts in (tenancy.get("status_counts") or {}).items():
+            self._tenant_status[t] = {s: int(c)
+                                      for s, c in counts.items()}
+        for t, n in (tenancy.get("preemptions") or {}).items():
+            self._tenant_preemptions[t] = int(n)
+        self._tenant_seen.update(tenancy.get("seen", ()))
         self._num_restores += 1
 
     def check_allocator_integrity(self) -> None:
@@ -2140,14 +2944,24 @@ class InferenceEngine:
         bookkeeping: internal invariants plus an EXACT refcount match —
         each block's count must equal the number of resident slots
         referencing it (chaos tests call this after restore + LRU
-        churn)."""
+        churn). The per-tenant reference split is cross-checked too:
+        each block's tenant refs must equal the residents referencing
+        it, split by their tenants — the certification that aborts,
+        quota sheds, and preemptions reclaimed exactly what they
+        owned."""
         expected: Dict[int, int] = {}
+        expected_tenants: Dict[int, Dict[str, int]] = {}
         for slot in self.slots:
             if slot is None:
                 continue
+            t = slot.request.tenant
             for b in slot.blocks:
                 expected[b] = expected.get(b, 0) + 1
-        self.allocator.check_integrity(expected_refcounts=expected)
+                per = expected_tenants.setdefault(b, {})
+                per[t] = per.get(t, 0) + 1
+        self.allocator.check_integrity(
+            expected_refcounts=expected,
+            expected_tenant_refs=expected_tenants)
 
     def stats(self) -> Dict[str, float]:
         alloc = self.allocator
@@ -2231,4 +3045,45 @@ class InferenceEngine:
             # degradation ladder (reversible)
             "speculation_active": int(self._drafter_ok
                                       and self._degradation_level < 1),
+            # dynamic speculation (spec_adapt): the adaptive per-plan
+            # cap, the acceptance EWMA driving it, and its transitions
+            "spec_cap": self._spec_cap,
+            "spec_accept_ewma": float(self._spec_accept_ewma or 0.0),
+            "num_spec_cap_shrinks": self._num_spec_cap_shrinks,
+            "num_spec_cap_restores": self._num_spec_cap_restores,
+            # multi-tenant isolation (docs/robustness.md): the global
+            # shed/cancel counters, the streaming backlog, and the
+            # per-tenant ledger
+            "num_throttled": self._num_throttled,
+            "num_cancelled": self._num_cancelled,
+            "stream_backlog": len(self._stream),
+            "tenants": self._tenant_section(),
         }
+
+    def _tenant_section(self) -> Dict[str, Dict[str, object]]:
+        """``stats()["tenants"]``: one row per tenant ever seen —
+        delivered tokens, the decayed rate estimate, current queue and
+        residency footprint (fractional block charge), the
+        eviction/flush attribution, quota preemptions, and terminal
+        statuses. The numbers an operator needs to tell WHICH tenant
+        is eating the replica."""
+        alloc_ts = self.allocator.tenant_stats()
+        out: Dict[str, Dict[str, object]] = {}
+        for t in sorted(self._tenant_seen | set(alloc_ts)):
+            a = alloc_ts.get(t, {})
+            out[t] = {
+                "tokens": self._tenant_tokens.get(t, 0),
+                "rate_tokens_per_s": round(self._tenant_rate_now(t), 6),
+                "waiting": self.waiting.tenant_depth(t),
+                "resident_slots": sum(
+                    1 for s in self.slots
+                    if s is not None and s.request.tenant == t),
+                "resident_block_charge":
+                    a.get("resident_block_charge", 0.0),
+                "cached_blocks": a.get("cached_blocks", 0),
+                "evicted_blocks": a.get("evicted_blocks", 0),
+                "flushed_blocks": a.get("flushed_blocks", 0),
+                "quota_preemptions": self._tenant_preemptions.get(t, 0),
+                "statuses": dict(self._tenant_status.get(t, {})),
+            }
+        return out

@@ -53,6 +53,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# the tenant every un-labelled caller is accounted to — single-tenant
+# traffic runs entirely under this id and behaves exactly like the
+# pre-tenancy allocator (the accounting is bookkeeping, never policy:
+# allocation ORDER is tenant-blind, so default-tenant behavior is
+# bit-identical)
+DEFAULT_TENANT = "default"
+
 
 def default_kv_dtype(dtype=None):
     """Resolve the KV-storage dtype through the amp policy: an explicit
@@ -166,6 +173,28 @@ class BlockAllocator:
         # refcount-0 registered blocks, insertion order = LRU order
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
         self.num_evictions = 0
+        # -- per-tenant accounting (docs/robustness.md, isolation) -----
+        # Every reference is attributed to a tenant: _tenant_refs[b]
+        # splits _ref[b] by holder, so a block shared across tenants
+        # charges each FRACTIONALLY by refcount (tenant_charge). Cached
+        # (refcount-0, prefix-indexed) blocks are attributed to the
+        # tenant that REGISTERED them (_cached_owner), so rung-2
+        # flushes and LRU evictions charge the tenant whose traffic
+        # parked the block. Pure bookkeeping: allocation/eviction ORDER
+        # never consults a tenant, so single-tenant behavior is
+        # bit-identical to the pre-tenancy allocator.
+        self._tenant_refs: Dict[int, Dict[str, int]] = {}
+        self._cached_owner: Dict[int, str] = {}
+        self._evicted_by_tenant: Dict[str, int] = {}
+        self._flushed_by_tenant: Dict[str, int] = {}
+        # incrementally-maintained fractional charge per tenant (the
+        # O(1) read behind tenant_charge — the engine consults it per
+        # admission candidate and per lane-growth check, so a scan
+        # over every active block would sit on the scheduler's hot
+        # path). _charge_block applies/removes one block's current
+        # shares around each mutation; check_integrity re-derives the
+        # exact sums and REBASES, bounding float drift.
+        self._tenant_charge_acc: Dict[str, float] = {}
 
     # -- accounting --------------------------------------------------------
 
@@ -191,19 +220,73 @@ class BlockAllocator:
     def refcount(self, block_id: int) -> int:
         return self._ref.get(int(block_id), 0)
 
+    def tenant_refcount(self, block_id: int, tenant: str) -> int:
+        """How many of a block's references ``tenant`` holds."""
+        return self._tenant_refs.get(int(block_id), {}).get(tenant, 0)
+
+    def _charge_block(self, b: int, sign: int) -> None:
+        """Apply (+1) or remove (-1) block ``b``'s CURRENT per-tenant
+        fractional shares to the running charge accumulator — called
+        around every mutation of the block's holder set."""
+        total = self._ref.get(b, 0)
+        if not total:
+            return
+        for t, n in self._tenant_refs[b].items():
+            self._tenant_charge_acc[t] = \
+                self._tenant_charge_acc.get(t, 0.0) + sign * n / total
+
+    def tenant_charge(self, tenant: str) -> float:
+        """The tenant's fractional resident-block charge: each active
+        block contributes ``tenant_refs / total_refs`` — a private
+        block charges 1.0, a block shared evenly across two tenants
+        charges each 0.5. This is the number the engine's
+        ``max_resident_blocks`` quota is enforced against (sharing a
+        prefix makes a tenant CHEAPER, never more expensive). O(1):
+        maintained incrementally by the mutation paths."""
+        return max(0.0, self._tenant_charge_acc.get(tenant, 0.0))
+
+    def tenant_stats(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant accounting picture: fractional resident charge,
+        cached (evictable) blocks attributed by registering tenant, and
+        the eviction/flush attribution counters."""
+        tenants = set(self._evicted_by_tenant) | set(self._flushed_by_tenant)
+        for refs in self._tenant_refs.values():
+            tenants.update(refs)
+        cached_by: Dict[str, int] = {}
+        for b in self._evictable:
+            owner = self._cached_owner.get(b)
+            if owner is not None:
+                tenants.add(owner)
+                cached_by[owner] = cached_by.get(owner, 0) + 1
+        return {t: {
+            "resident_block_charge": round(self.tenant_charge(t), 6),
+            "cached_blocks": cached_by.get(t, 0),
+            "evicted_blocks": self._evicted_by_tenant.get(t, 0),
+            "flushed_blocks": self._flushed_by_tenant.get(t, 0),
+        } for t in sorted(tenants)}
+
     # -- alloc / free / share ----------------------------------------------
 
-    def _evict_one(self) -> int:
-        """Drop the least-recently-used cached block (unregister it)."""
+    def _evict_one(self, flushed: bool = False) -> int:
+        """Drop the least-recently-used cached block (unregister it),
+        charging the eviction to the tenant that registered the block
+        (``flushed`` routes the charge to the flush counter — the
+        degradation ladder's rung-2 accounting)."""
         b, _ = self._evictable.popitem(last=False)
         h = self._block_to_hash.pop(b)
         del self._hash_to_block[h]
+        owner = self._cached_owner.pop(b, None)
+        if owner is not None:
+            counter = (self._flushed_by_tenant if flushed
+                       else self._evicted_by_tenant)
+            counter[owner] = counter.get(owner, 0) + 1
         self.num_evictions += 1
         return b
 
-    def alloc(self, n: int) -> List[int]:
-        """Hand out ``n`` blocks at refcount 1, evicting LRU cached
-        blocks when the free list alone cannot serve the request."""
+    def alloc(self, n: int, tenant: str = DEFAULT_TENANT) -> List[int]:
+        """Hand out ``n`` blocks at refcount 1 (charged to ``tenant``),
+        evicting LRU cached blocks when the free list alone cannot
+        serve the request."""
         if n > len(self._free) + len(self._evictable):
             raise CacheOutOfBlocks(
                 f"requested {n} blocks, {len(self._free)} free + "
@@ -212,52 +295,80 @@ class BlockAllocator:
         for _ in range(n):
             b = self._free.pop() if self._free else self._evict_one()
             self._ref[b] = 1
+            self._tenant_refs[b] = {tenant: 1}
+            self._charge_block(b, +1)
             out.append(b)
         return out
 
-    def free(self, ids: Sequence[int]) -> None:
-        """Release one reference per id. A registered block whose count
-        hits zero is retained as cached (evictable); an unregistered one
-        returns to the free list. Raises ``ValueError`` on an unknown
-        block id or a double free (releasing a block that holds no
-        reference) instead of silently corrupting the free list."""
+    def free(self, ids: Sequence[int], tenant: str = DEFAULT_TENANT) -> None:
+        """Release one of ``tenant``'s references per id. A registered
+        block whose count hits zero is retained as cached (evictable);
+        an unregistered one returns to the free list. Raises
+        ``ValueError`` on an unknown block id, a double free (releasing
+        a block that holds no reference), or a tenant releasing a
+        reference it never took, instead of silently corrupting the
+        free list or the tenant ledger."""
         for b in ids:
             b = int(b)
             if not (0 <= b < self.num_blocks):
                 raise ValueError(f"block id {b} out of range")
             if self._ref.get(b, 0) <= 0:
                 raise ValueError(f"double free of block {b}")
+            holders = self._tenant_refs[b]
+            if holders.get(tenant, 0) <= 0:
+                raise ValueError(
+                    f"tenant {tenant!r} holds no reference on block {b} "
+                    f"(holders: {holders})")
+            self._charge_block(b, -1)
+            holders[tenant] -= 1
+            if holders[tenant] == 0:
+                del holders[tenant]
             self._ref[b] -= 1
             if self._ref[b] == 0:
                 del self._ref[b]
+                del self._tenant_refs[b]
                 if b in self._block_to_hash:
                     self._evictable[b] = None      # most-recently-used end
                 else:
                     self._free.append(b)
+            else:
+                self._charge_block(b, +1)
 
-    def acquire(self, ids: Sequence[int]) -> None:
-        """Add one reference per id (prefix sharing). Revives cached
-        (refcount-0) blocks; raises for blocks that are neither active
-        nor cached — a free block holds no meaningful contents."""
+    def acquire(self, ids: Sequence[int],
+                tenant: str = DEFAULT_TENANT) -> None:
+        """Add one reference per id for ``tenant`` (prefix sharing).
+        Revives cached (refcount-0) blocks; raises for blocks that are
+        neither active nor cached — a free block holds no meaningful
+        contents."""
         for b in ids:
             b = int(b)
             if self._ref.get(b, 0) > 0:
+                self._charge_block(b, -1)
                 self._ref[b] += 1
+                holders = self._tenant_refs[b]
+                holders[tenant] = holders.get(tenant, 0) + 1
+                self._charge_block(b, +1)
             elif b in self._evictable:
                 del self._evictable[b]
                 self._ref[b] = 1
+                self._tenant_refs[b] = {tenant: 1}
+                self._charge_block(b, +1)
             else:
                 raise ValueError(
                     f"cannot acquire block {b}: neither active nor cached")
 
     # -- the prefix index --------------------------------------------------
 
-    def register_prefix(self, block_hash: str, block_id: int) -> bool:
+    def register_prefix(self, block_hash: str, block_id: int,
+                        tenant: str = DEFAULT_TENANT) -> bool:
         """Index a FULL block's contents under its chain hash. First
         registration wins — a concurrent identical prefill keeps the
         already-indexed block and leaves the duplicate unregistered (it
-        returns to the free list when released). Returns whether this
-        block is now the indexed one."""
+        returns to the free list when released). The winning
+        registration records ``tenant`` as the block's cached-state
+        owner: if the block is ever evicted or flushed while cached,
+        THAT tenant is charged. Returns whether this block is now the
+        indexed one."""
         block_id = int(block_id)
         if block_hash in self._hash_to_block:
             return self._hash_to_block[block_hash] == block_id
@@ -265,6 +376,7 @@ class BlockAllocator:
             return False
         self._hash_to_block[block_hash] = block_id
         self._block_to_hash[block_id] = block_hash
+        self._cached_owner[block_id] = tenant
         return True
 
     def lookup_prefix(self, hashes: Sequence[str]) -> List[int]:
@@ -279,15 +391,18 @@ class BlockAllocator:
             out.append(b)
         return out
 
-    def match_prefix(self, hashes: Sequence[str]) -> List[int]:
+    def match_prefix(self, hashes: Sequence[str],
+                     tenant: str = DEFAULT_TENANT) -> List[int]:
         """Longest indexed prefix of the hash chain: returns the block
-        ids (in sequence order) and acquires a reference on each —
-        callers own the returned blocks and must ``free`` them."""
+        ids (in sequence order) and acquires a reference on each for
+        ``tenant`` — callers own the returned blocks and must ``free``
+        them under the same tenant."""
         out = self.lookup_prefix(hashes)
-        self.acquire(out)
+        self.acquire(out, tenant=tenant)
         return out
 
-    def trim_to(self, blocks: Sequence[int], keep: int) -> List[int]:
+    def trim_to(self, blocks: Sequence[int], keep: int,
+                tenant: str = DEFAULT_TENANT) -> List[int]:
         """Release the tail of a sequence's block list past its first
         ``keep`` entries and return the kept prefix as a new list — the
         **speculative-reservation rollback**: the engine reserves
@@ -318,7 +433,7 @@ class BlockAllocator:
                 raise ValueError(
                     f"cannot trim block {b}: registered in the prefix "
                     "index (it is matchable cached context)")
-        self.free(list(reversed(tail)))
+        self.free(list(reversed(tail)), tenant=tenant)
         return blocks[:keep]
 
     def flush_evictable(self) -> int:
@@ -327,10 +442,11 @@ class BlockAllocator:
         rung (docs/robustness.md): under sustained pool pressure the
         engine trades future prefix hits for immediately-allocatable
         headroom. Each drop counts as an eviction (the blocks really do
-        leave the index). Returns how many blocks were flushed."""
+        leave the index) and charges the registering tenant's flush
+        counter. Returns how many blocks were flushed."""
         n = len(self._evictable)
         while self._evictable:
-            self._free.append(self._evict_one())
+            self._free.append(self._evict_one(flushed=True))
         return n
 
     def reset(self) -> None:
@@ -339,6 +455,12 @@ class BlockAllocator:
         self._hash_to_block.clear()
         self._block_to_hash.clear()
         self._evictable.clear()
+        self._tenant_refs.clear()
+        self._cached_owner.clear()
+        self._tenant_charge_acc.clear()
+        # the eviction/flush attribution counters deliberately survive:
+        # reset is the crash-recovery path, and observability should
+        # not lose history to it (matching num_evictions)
 
     # -- robustness: audit + integrity (docs/robustness.md) ----------------
 
@@ -356,16 +478,26 @@ class BlockAllocator:
             "evictable": [int(b) for b in self._evictable],
             "free": [int(b) for b in self._free],
             "num_evictions": int(self.num_evictions),
+            "tenant_refs": {str(b): dict(refs)
+                            for b, refs in self._tenant_refs.items()},
+            "cached_owners": {str(b): t
+                              for b, t in self._cached_owner.items()},
+            "evicted_by_tenant": dict(self._evicted_by_tenant),
+            "flushed_by_tenant": dict(self._flushed_by_tenant),
         }
 
     def check_integrity(self, expected_refcounts: Optional[Dict[int, int]]
-                        = None) -> None:
+                        = None,
+                        expected_tenant_refs: Optional[
+                            Dict[int, Dict[str, int]]] = None) -> None:
         """Raise ``ValueError`` on any violated allocator invariant:
         every block in exactly one of {free, active, cached}; the
         hash↔block maps a bijection; cached blocks registered at
-        refcount 0; and, when the caller supplies the refcounts its own
-        bookkeeping implies (one per sequence referencing the block),
-        an EXACT match against the internal counts."""
+        refcount 0; the per-tenant reference split summing exactly to
+        each block's refcount; and, when the caller supplies the
+        refcounts its own bookkeeping implies (one per sequence
+        referencing the block — optionally split by tenant), an EXACT
+        match against the internal counts."""
         free, active = set(self._free), set(self._ref)
         cached = set(self._evictable)
         if len(free) != len(self._free):
@@ -395,6 +527,48 @@ class BlockAllocator:
         if registered_free:
             raise ValueError(
                 f"free blocks still indexed: {sorted(registered_free)}")
+        if set(self._tenant_refs) != active:
+            raise ValueError(
+                f"tenant-ref map keys {sorted(self._tenant_refs)} != "
+                f"active blocks {sorted(active)}")
+        for b, refs in self._tenant_refs.items():
+            if any(c <= 0 for c in refs.values()):
+                raise ValueError(
+                    f"block {b}: non-positive tenant refcount {refs}")
+            if sum(refs.values()) != self._ref[b]:
+                raise ValueError(
+                    f"block {b}: tenant refs {refs} sum to "
+                    f"{sum(refs.values())}, refcount is {self._ref[b]}")
+        stray_owner = set(self._cached_owner) - set(self._block_to_hash)
+        if stray_owner:
+            raise ValueError(
+                f"cached-owner entries for unregistered blocks: "
+                f"{sorted(stray_owner)}")
+        # the incremental charge accumulator must track the exact
+        # per-block sums (within float tolerance); verified then
+        # REBASED to the exact values so drift never accumulates
+        # across integrity checkpoints
+        exact: Dict[str, float] = {}
+        for b, refs in self._tenant_refs.items():
+            for t, n in refs.items():
+                exact[t] = exact.get(t, 0.0) + n / self._ref[b]
+        for t in set(exact) | set(self._tenant_charge_acc):
+            if abs(exact.get(t, 0.0)
+                   - self._tenant_charge_acc.get(t, 0.0)) > 1e-6:
+                raise ValueError(
+                    f"tenant {t!r}: incremental charge "
+                    f"{self._tenant_charge_acc.get(t, 0.0)} diverged "
+                    f"from exact {exact.get(t, 0.0)}")
+        self._tenant_charge_acc = exact
+        if expected_tenant_refs is not None:
+            expect = {int(b): {t: int(c) for t, c in refs.items() if c > 0}
+                      for b, refs in expected_tenant_refs.items()}
+            expect = {b: refs for b, refs in expect.items() if refs}
+            if expect != self._tenant_refs:
+                raise ValueError(
+                    f"tenant refs diverge from caller bookkeeping: "
+                    f"expected {expect}, allocator holds "
+                    f"{self._tenant_refs}")
         if expected_refcounts is not None:
             expected = {int(b): int(c) for b, c in expected_refcounts.items()
                         if int(c) > 0}
@@ -552,13 +726,23 @@ def defragment(cache: KVCache, allocator: BlockAllocator,
             tables[idx] = mapping[int(old)]
     # rebuild allocator state in the compacted id space: cached blocks
     # are evicted, live blocks keep their refcounts and index entries
+    for b in allocator._evictable:       # dropped, charged as evictions
+        owner = allocator._cached_owner.pop(b, None)
+        if owner is not None:
+            allocator._evicted_by_tenant[owner] = \
+                allocator._evicted_by_tenant.get(owner, 0) + 1
     allocator.num_evictions += len(allocator._evictable)
     allocator._evictable.clear()
     allocator._ref = {mapping[b]: c for b, c in allocator._ref.items()}
+    allocator._tenant_refs = {mapping[b]: refs for b, refs in
+                              allocator._tenant_refs.items()}
     allocator._hash_to_block = {
         h: mapping[b] for h, b in allocator._hash_to_block.items()
         if b in mapping}
     allocator._block_to_hash = {
         b: h for h, b in allocator._hash_to_block.items()}
+    allocator._cached_owner = {
+        mapping[b]: t for b, t in allocator._cached_owner.items()
+        if b in mapping}
     allocator._free = list(range(cache.num_blocks - 1, len(live) - 1, -1))
     return gather_blocks(cache, jnp.asarray(perm)), tables

@@ -24,6 +24,7 @@ surface; this helper is the full-training-state tier above it.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -42,45 +43,124 @@ def checkpoint_path(directory: str, step: int) -> str:
                         f"step_{step:09d}")
 
 
+def _marker_path(directory: str, step: int) -> str:
+    """The step's terminal commit marker — a sibling manifest file, NOT
+    inside the orbax directory (orbax owns that layout). Its existence
+    is the definition of "this checkpoint finished saving"."""
+    return checkpoint_path(directory, step) + ".complete"
+
+
+def _write_marker(directory: str, step: int, names) -> None:
+    """The terminal write of a save: a small JSON manifest (step + tree
+    names), written to a temp file and atomically renamed into place so
+    the marker itself can never be observed torn."""
+    marker = _marker_path(directory, step)
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step), "trees": sorted(names)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker)
+
+
 def save_checkpoint(directory: str, step: int, **trees) -> str:
     """Save named pytrees (params=..., opt_state=..., scaler_state=...)
     as one checkpoint under ``directory/step_NNNNNNNNN``. Returns the
     path. Overwrites an existing checkpoint at the same step (resume
-    after preemption re-saves the same step)."""
+    after preemption re-saves the same step).
+
+    **Crash-safe**: the payload write is finalized by an atomic
+    manifest/marker write (``step_NNNNNNNNN.complete``), and
+    :func:`latest_step` / :func:`load_checkpoint` only see steps whose
+    marker exists — a process killed mid-save leaves a torn payload
+    that resume simply skips (it picks the previous complete step)
+    instead of loading garbage. Overwriting an existing step removes
+    its marker FIRST, so a crash mid-overwrite also reads as
+    incomplete rather than serving the half-replaced payload."""
     path = checkpoint_path(directory, step)
+    marker = _marker_path(directory, step)
+    # flip the directory to marker-governed BEFORE the payload write:
+    # a fresh directory whose very first save is killed mid-payload
+    # must read as torn, not fall into the legacy (pre-marker) path
+    os.makedirs(os.path.abspath(os.fspath(directory)), exist_ok=True)
+    era = os.path.join(os.path.abspath(os.fspath(directory)),
+                       _ERA_SENTINEL)
+    if not os.path.exists(era):
+        with open(era, "w") as f:
+            f.write("markers govern this directory\n")
+    if os.path.exists(marker):
+        os.remove(marker)
     payload = {k: v for k, v in trees.items() if v is not None}
     payload["_step"] = step
     _checkpointer().save(path, payload, force=True)
+    _write_marker(directory, step, payload.keys())
     return path
 
 
+_ERA_SENTINEL = ".checkpoint-markers"
+
+
+def _directory_is_marker_governed(directory: str) -> bool:
+    """True once the directory has ever been written by marker-era
+    code: the era sentinel (written BEFORE the first payload, so even
+    a torn very-first save is governed) or any step marker."""
+    if os.path.exists(os.path.join(directory, _ERA_SENTINEL)):
+        return True
+    return any(name.endswith(".complete")
+               for name in os.listdir(directory))
+
+
 def latest_step(directory: str) -> Optional[int]:
-    """Highest step with a checkpoint in ``directory``, or None."""
+    """Highest COMPLETE step in ``directory`` (its commit marker
+    exists), or None. Unfinished saves — payload present, marker
+    absent — are invisible here by design.
+
+    **Legacy fallback**: a directory containing NO markers at all was
+    written entirely by the pre-marker code; its steps are all treated
+    as complete (exactly the old behavior), so upgrading never makes
+    an existing run's checkpoints invisible. The moment one marker
+    exists, the directory is marker-governed and marker-less steps
+    read as torn."""
     if not os.path.isdir(directory):
         return None
+    strict = _directory_is_marker_governed(directory)
     steps = []
     for name in os.listdir(directory):
-        if name.startswith("step_"):
+        if name.startswith("step_") and not name.endswith((".complete",
+                                                           ".tmp")):
             try:
-                steps.append(int(name[len("step_"):]))
+                step = int(name[len("step_"):])
             except ValueError:
                 continue
+            if not strict or os.path.exists(_marker_path(directory, step)):
+                steps.append(step)
     return max(steps) if steps else None
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None,
                     template: Optional[Any] = None):
-    """Restore a checkpoint (``step=None`` → latest).
+    """Restore a checkpoint (``step=None`` → latest COMPLETE step).
 
     ``template`` is a pytree of arrays or ShapeDtypeStructs with the
     SAME named-tree structure passed to :func:`save_checkpoint`; it
     restores container types (NamedTuples) that serialization flattens.
-    Returns the restored dict of trees (plus ``_step``).
+    Returns the restored dict of trees (plus ``_step``). An explicitly
+    requested ``step`` whose commit marker is missing raises — a torn
+    save must never be resumed from, even by name.
     """
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    elif (not os.path.exists(_marker_path(directory, step))
+          and os.path.isdir(directory)
+          and _directory_is_marker_governed(directory)):
+        # same legacy fallback as latest_step: only a marker-governed
+        # directory treats a marker-less step as torn
+        raise FileNotFoundError(
+            f"checkpoint step {step} under {directory!r} has no commit "
+            f"marker — the save did not finish (torn checkpoint); "
+            f"resume from latest_step() instead")
     path = checkpoint_path(directory, step)
     if template is not None:
         item = dict(template)

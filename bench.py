@@ -988,8 +988,10 @@ def bench_serving(fast=False):
         "prefix_overlap_0pct": arm0,
         "prefix_overlap_90pct": arm90,
         "scheduler_stats": {
+            # scalar counters only; the nested per-tenant ledger
+            # ("tenants") has its own bench arm
             k: (round(v, 4) if isinstance(v, float) else int(v))
-            for k, v in s90.items()
+            for k, v in s90.items() if not isinstance(v, dict)
         },
     }
 
@@ -1251,6 +1253,27 @@ def bench_serving_speculative(fast=False):
     }
 
 
+def _poisson_burst_trace(rng, ticks, base_rate, make_request,
+                         burst_start=None, burst_end=None,
+                         burst_factor=1):
+    """The shared seeded trace builder for the serving stress arms
+    (overload, multitenant): per tick, ``Poisson(base_rate)`` arrivals
+    — ``burst_factor`` x inside ``[burst_start, burst_end)`` — each
+    materialized by ``make_request(tick, k)`` (``k`` = the arrival's
+    index within the trace). One generator, one rng, so traces stay
+    seeded and COMPARABLE across arms: the same (rng state, rates)
+    always yields the same burst."""
+    trace, k = [], 0
+    for tick in range(ticks):
+        burst = (burst_start is not None
+                 and burst_start <= tick < burst_end)
+        rate = base_rate * (burst_factor if burst else 1)
+        for _ in range(int(rng.poisson(rate))):
+            trace.append((tick, make_request(tick, k)))
+            k += 1
+    return trace
+
+
 def bench_serving_overload(fast=False):
     """Overload / tail-latency arm (round 8): a seeded bursty trace —
     Poisson-ish arrivals with a 4x burst phase in the middle, mixed
@@ -1315,22 +1338,23 @@ def bench_serving_overload(fast=False):
 
     # the trace, built up front (seeded => the same burst every round):
     # arrivals-per-tick ~ Poisson(rate); the middle phase runs at 4x
-    trace, uid = [], 0
-    for tick in range(3 * phase_ticks):
-        burst = phase_ticks <= tick < 2 * phase_ticks
-        for _ in range(int(rng.poisson(base_rate * (4 if burst else 1)))):
-            dl = deadlines[int(rng.randint(len(deadlines)))]
-            trace.append((tick, Request(
-                uid=f"o{uid}",
-                prompt=list(rng.randint(
-                    0, cfg.vocab_size,
-                    int(rng.choice(prompt_lens)))),
-                max_new_tokens=int(rng.choice(max_news)),
-                priority=int(rng.choice((0, 1, 2), p=(0.3, 0.5, 0.2))),
-                deadline_s=dl,
-                sampling=(SamplingParams() if uid % 2 == 0 else
-                          SamplingParams(temperature=1.0, top_k=40)))))
-            uid += 1
+    def make_request(tick, uid):
+        dl = deadlines[int(rng.randint(len(deadlines)))]
+        return Request(
+            uid=f"o{uid}",
+            prompt=list(rng.randint(
+                0, cfg.vocab_size,
+                int(rng.choice(prompt_lens)))),
+            max_new_tokens=int(rng.choice(max_news)),
+            priority=int(rng.choice((0, 1, 2), p=(0.3, 0.5, 0.2))),
+            deadline_s=dl,
+            sampling=(SamplingParams() if uid % 2 == 0 else
+                      SamplingParams(temperature=1.0, top_k=40)))
+
+    trace = _poisson_burst_trace(
+        rng, ticks=3 * phase_ticks, base_rate=base_rate,
+        make_request=make_request, burst_start=phase_ticks,
+        burst_end=2 * phase_ticks, burst_factor=4)
 
     submit_t, first_tok_t, last_obs_t, last_counts = {}, {}, {}, {}
     ttfts, gaps = [], []
@@ -1444,6 +1468,260 @@ def bench_serving_overload(fast=False):
         "degrade_steps_up": int(stats["num_degrade_steps_up"]),
         "queue_wait_mean_s": round(float(stats["queue_wait_mean_s"]), 6),
         "queue_wait_max_s": round(float(stats["queue_wait_max_s"]), 6),
+    }
+
+
+def bench_serving_multitenant(fast=False):
+    """Multi-tenant isolation arm (round 10): one ADVERSARIAL flood
+    tenant against two well-behaved tenants with deadlines, all
+    sharing a prefix-cached pool under the tenancy stack — weighted
+    DRR admission, per-tenant quotas (waiting cap + resident-block
+    ceiling + token-rate budget on the flood), streaming delivery.
+
+    Three phases: (1) the victims run SOLO (their exact seeded traces,
+    no flood) to baseline per-tenant p99 TTFT; (2) the same victim
+    traces run against the flood — the arm reports per-tenant goodput
+    and p99 TTFT and ASSERTS the flood is the only tenant ever shed or
+    throttled and the victims' p99 TTFT (in scheduler ticks — the
+    deterministic unit) stays within its bound of the solo baseline;
+    (3) a chaos engine mixes aborts, quota sheds, injected
+    prefill/decode faults, and degradation-ladder steps over the same
+    trace shape, then must pass ``check_allocator_integrity`` (the
+    per-tenant refcount split certified exactly) with every accepted
+    request terminal. ``vs_baseline`` is combined victim goodput /
+    solo victim goodput. ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
+                                  SamplingParams, TenantQuota)
+    from apex_tpu.utils.faults import FaultPlan, FaultSpec
+
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ekw = dict(max_batch=16, block_size=32, num_blocks=512,
+                   max_prefill_len=256, max_seq_len=512,
+                   kv_dtype=jnp.bfloat16, max_waiting=64,
+                   enable_prefix_caching=True)
+        victim_rate, flood_rate, ticks = 0.5, 4.0, 80
+        prompt_lens, max_news = (64, 128), (16, 32)
+        flood_quota = TenantQuota(max_waiting=8, max_resident_blocks=24,
+                                  tokens_per_s=2000.0)
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ekw = dict(max_batch=4, block_size=8, num_blocks=64,
+                   max_prefill_len=16, max_seq_len=48, max_waiting=24,
+                   enable_prefix_caching=True)
+        victim_rate = 0.25 if fast else 0.35
+        flood_rate = 1.5
+        ticks = 24 if fast else 48
+        prompt_lens, max_news = (6, 10), (3, 5)
+        flood_quota = TenantQuota(max_waiting=4, max_resident_blocks=5,
+                                  tokens_per_s=5000.0)
+    tenancy = dict(
+        tenant_weights={"acme": 4, "bolt": 4, "flood": 1},
+        tenant_quotas={"flood": flood_quota},
+        drr_quantum=16)
+    model = GPTLMHeadModel(cfg)
+    # FIXED seeds (not _SALT): this arm asserts on shed attribution,
+    # tail-latency bounds, and chaos-path coverage — the trace must be
+    # the same every round or the asserts flake
+    init_rng = np.random.RandomState(1789)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(init_rng.randint(0, cfg.vocab_size, (1, 8))))
+
+    def victim_trace():
+        # victims get their OWN rng so the solo and combined runs see
+        # byte-identical victim traffic
+        rng = np.random.RandomState(1790)
+
+        def make(tick, k):
+            tenant = ("acme", "bolt")[k % 2]
+            return Request(
+                uid=f"{tenant}-{k}",
+                prompt=list(rng.randint(0, cfg.vocab_size,
+                                        int(rng.choice(prompt_lens)))),
+                max_new_tokens=int(rng.choice(max_news)),
+                tenant=tenant, deadline_s=30.0,
+                sampling=(SamplingParams() if k % 2 == 0 else
+                          SamplingParams(temperature=1.0, top_k=40)))
+
+        return _poisson_burst_trace(rng, ticks=ticks,
+                                    base_rate=victim_rate,
+                                    make_request=make)
+
+    def flood_trace():
+        rng = np.random.RandomState(1791)
+        shared = list(rng.randint(0, cfg.vocab_size, prompt_lens[-1]))
+
+        def make(tick, k):
+            # the adversary: high rate, no deadlines, identical
+            # prompts (it also tries to squat on the prefix cache)
+            return Request(uid=f"flood-{k}", prompt=list(shared),
+                           max_new_tokens=int(max_news[-1]),
+                           tenant="flood")
+
+        return _poisson_burst_trace(rng, ticks=ticks,
+                                    base_rate=flood_rate,
+                                    make_request=make)
+
+    def drive(engine, trace, abort_every=None):
+        """Tick the engine through the trace; per-uid submit tick and
+        first-token tick (host-visible, via the streaming API), door
+        sheds per tenant, optional every-Nth-accepted abort schedule.
+        Returns (ttft_ticks per uid, door_sheds per tenant, aborted
+        uids, wall seconds, stalls)."""
+        submit, first = {}, {}
+        sheds, aborted, accepted = {}, [], []
+        stalls = 0
+        t0 = time.perf_counter()
+        i = tick = 0
+        while i < len(trace) or engine.has_work:
+            while i < len(trace) and trace[i][0] <= tick:
+                req = trace[i][1]
+                if engine.try_add(req):
+                    submit[req.uid] = tick
+                    accepted.append(req.uid)
+                    if (abort_every
+                            and len(accepted) % abort_every == 0):
+                        aborted.append(req.uid)
+                else:
+                    t = req.tenant
+                    sheds[t] = sheds.get(t, 0) + 1
+                i += 1
+            for uid in aborted[:]:
+                if engine.abort(uid):
+                    aborted.remove(uid)
+                    aborted.append("done:" + uid)
+            had = engine.has_work
+            progressed = engine.step()
+            if had and not progressed:
+                stalls += 1
+            for uid, tok, last in engine.pop_stream_events():
+                if tok >= 0 and uid not in first and uid in submit:
+                    first[uid] = tick
+            tick += 1
+        wall = time.perf_counter() - t0
+        ttft = {u: first[u] - submit[u] for u in first}
+        return ttft, sheds, aborted, wall, stalls
+
+    def pct(xs, q):
+        return float(np.percentile(xs, q)) if xs else 0.0
+
+    victims = victim_trace()
+
+    # phase 1: victims solo — the baseline each tenant is entitled to
+    engine = InferenceEngine(model, params, EngineConfig(**ekw, **tenancy))
+    ttft_solo, _, _, wall_solo, stalls0 = drive(engine, victims)
+    solo_res = engine.run(return_status=True)
+    solo_good = {t: sum(len(r.tokens) for u, r in solo_res.items()
+                        if r.status == "finished" and u.startswith(t))
+                 for t in ("acme", "bolt")}
+    solo_p99 = {t: pct([v for u, v in ttft_solo.items()
+                        if u.startswith(t)], 99)
+                for t in ("acme", "bolt")}
+
+    # phase 2: the same victim traffic + the flood
+    combined = sorted(victims + flood_trace(), key=lambda x: x[0])
+    engine = InferenceEngine(model, params, EngineConfig(**ekw, **tenancy))
+    ttft_mix, sheds, _, wall_mix, stalls1 = drive(engine, combined)
+    mix_res = engine.run(return_status=True)
+    stats = engine.stats()
+    tstats = stats["tenants"]
+    good = {t: sum(len(r.tokens) for u, r in mix_res.items()
+                   if r.status == "finished" and u.startswith(t))
+            for t in ("acme", "bolt", "flood")}
+    mix_p99 = {t: pct([v for u, v in ttft_mix.items()
+                       if u.startswith(t)], 99)
+               for t in ("acme", "bolt")}
+    bad_status = {u: r.status for u, r in mix_res.items()
+                  if r.status in ("throttled", "rejected")}
+
+    assert stalls0 == stalls1 == 0, (stalls0, stalls1)
+    # isolation bar 1: the flood is the ONLY tenant ever shed at the
+    # door or throttled by quota — victims never pay for it
+    assert all(t == "flood" for t in sheds), sheds
+    assert all(u.startswith("flood") for u in bad_status), bad_status
+    assert stats["num_throttled"] > 0 or sheds, (
+        "the flood was never shed — the arm is not exercising quotas")
+    # isolation bar 2: victim tail latency holds within its bound of
+    # the solo baseline (ticks — the deterministic scheduler unit)
+    for t in ("acme", "bolt"):
+        bound = 3.0 * solo_p99[t] + 12.0
+        assert mix_p99[t] <= bound, (
+            f"victim {t}: p99 TTFT {mix_p99[t]} ticks vs solo "
+            f"{solo_p99[t]} (bound {bound})")
+        assert good[t] > 0, good
+
+    # phase 3: chaos — aborts + quota sheds + faults + ladder steps,
+    # then the allocator must account for every block exactly
+    faults = FaultPlan([
+        FaultSpec(site="prefill", kind="transient", every=11),
+        FaultSpec(site="decode", kind="transient", every=13),
+    ], seed=1792)
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(**{**ekw, "max_waiting": 8}, **tenancy,
+                     # low watermarks: the chaos phase must actually
+                     # walk the ladder (the flood quota caps its queue
+                     # share at 4, so 4 is the reachable pressure mark)
+                     queue_high_watermark=4,
+                     free_block_low_watermark=0.25,
+                     degrade_patience=1, max_dispatch_retries=3),
+        faults=faults)
+    _, chaos_sheds, chaos_aborts, _, chaos_stalls = drive(
+        engine, combined, abort_every=5)
+    chaos_res = engine.run(return_status=True)
+    engine.check_allocator_integrity()
+    cstats = engine.stats()
+    assert chaos_stalls == 0
+    assert cstats["num_cancelled"] > 0, "chaos fired no aborts"
+    assert cstats["num_dispatch_retries"] > 0, "chaos fired no faults"
+    assert (cstats["num_throttled"] > 0 or chaos_sheds), \
+        "chaos fired no quota sheds"
+    assert cstats["num_degrade_steps_down"] > 0, \
+        "chaos never stepped the ladder"
+
+    victim_good = (good["acme"] + good["bolt"]) / max(wall_mix, 1e-9)
+    solo_victim_good = ((solo_good["acme"] + solo_good["bolt"])
+                        / max(wall_solo, 1e-9))
+    print(f"# serving multitenant: victims solo p99 TTFT "
+          f"{solo_p99['acme']:.0f}/{solo_p99['bolt']:.0f} ticks -> "
+          f"vs flood {mix_p99['acme']:.0f}/{mix_p99['bolt']:.0f} | "
+          f"victim goodput {victim_good:.1f} (solo "
+          f"{solo_victim_good:.1f}) tok/s | flood finished "
+          f"{good['flood']} tok, shed {sheds.get('flood', 0)} door + "
+          f"{int(stats['num_throttled'])} throttled | chaos: "
+          f"{int(cstats['num_cancelled'])} aborts, "
+          f"{int(cstats['num_dispatch_retries'])} retries, ladder down "
+          f"{int(cstats['num_degrade_steps_down'])}, integrity OK",
+          file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_multitenant_victim_goodput_tok_per_sec"
+                   if on_tpu else
+                   "serving_tiny_multitenant_victim_goodput_tok_per_sec"),
+        "value": round(victim_good, 3),
+        "unit": "tokens/sec",
+        # isolation quality: combined-run victim goodput vs their solo
+        # entitlement (1.0 = the flood cost the victims nothing)
+        "vs_baseline": round(victim_good / max(solo_victim_good, 1e-9),
+                             4),
+        "per_tenant": {
+            t: {"goodput_tokens": good[t],
+                "p99_ttft_ticks": mix_p99.get(t),
+                "solo_p99_ttft_ticks": solo_p99.get(t),
+                "door_sheds": sheds.get(t, 0),
+                "throttled": int(tstats.get(t, {}).get(
+                    "statuses", {}).get("throttled", 0))}
+            for t in ("acme", "bolt", "flood")},
+        "num_offered": len(combined),
+        "flood_only_shed": True,
+        "chaos_aborts": int(cstats["num_cancelled"]),
+        "chaos_retries": int(cstats["num_dispatch_retries"]),
+        "chaos_ladder_steps_down": int(cstats["num_degrade_steps_down"]),
+        "chaos_throttled": int(cstats["num_throttled"]),
+        "allocator_integrity_ok": True,
     }
 
 
@@ -1628,6 +1906,8 @@ def main():
              lambda: bench_serving_speculative(fast=True)),
             ("bench_serving_overload",
              lambda: bench_serving_overload(fast=True)),
+            ("bench_serving_multitenant",
+             lambda: bench_serving_multitenant(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
         ):
             if not _run_section(name, fn, retries=0):
@@ -1691,7 +1971,7 @@ def main():
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
-                 bench_train_step]
+                 bench_serving_multitenant, bench_train_step]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

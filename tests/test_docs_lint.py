@@ -1,0 +1,37 @@
+"""Doc-drift lint as a tier-1 test: every ``EngineConfig`` /
+``TenantQuota`` field and every top-level ``stats()`` key must be
+named in docs/serving.md or docs/robustness.md — the next knob or
+counter cannot land undocumented (tools/check_docs.py)."""
+
+import importlib.util
+from pathlib import Path
+
+
+def _load_check_docs():
+    path = Path(__file__).resolve().parents[1] / "tools" / "check_docs.py"
+    spec = importlib.util.spec_from_file_location("_check_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serving_surface_is_documented():
+    mod = _load_check_docs()
+    missing = mod.main()
+    assert missing == [], (
+        "undocumented serving surface (add the literal name to "
+        "docs/serving.md or docs/robustness.md): " + repr(missing))
+
+
+def test_lint_actually_detects_drift(monkeypatch, tmp_path):
+    """The lint must FAIL on a genuinely missing name — guard against
+    the checker rotting into a tautology."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+
+    def with_phantom():
+        return orig() + [("stats() key", "phantom_counter_xyz")]
+
+    monkeypatch.setattr(mod, "collect_names", with_phantom)
+    missing = mod.main()
+    assert ("stats() key", "phantom_counter_xyz") in missing

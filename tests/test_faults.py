@@ -307,8 +307,8 @@ def test_fetch_time_failure_rolls_back_and_redispatches_bit_identically(
         engine.add_request(r)
     while engine._pending is None:
         engine.step()
-    toks, active = engine._pending
-    engine._pending = (_PoisonedFetch(toks, 1), active)
+    toks, active, uids = engine._pending
+    engine._pending = (_PoisonedFetch(toks, 1), active, uids)
     out = engine.run(return_status=True)
     # the in-process reset requeues residents with their emitted
     # tokens and re-prefills: same tokens, nothing lost, nobody failed
@@ -605,6 +605,135 @@ def test_restore_mid_degradation_is_bit_identical(tiny_gpt):
     combined.update(restored.run())
     assert combined == ref
     restored.check_allocator_integrity()
+
+
+def test_multitenant_chaos_aborts_quotas_faults_ladder(tiny_gpt):
+    """The ISSUE 10 chaos gate: aborts fired mid-flight, per-tenant
+    quota sheds, transient prefill/decode faults, and degradation-
+    ladder steps over interleaved tenants — the engine must never
+    stall, land every accepted request on a terminal status, fire
+    every chaos path at least once, and leave the allocator's
+    per-tenant refcount split EXACT."""
+    from apex_tpu.serving import TenantQuota
+
+    plan = FaultPlan([FaultSpec(site="prefill", kind="transient",
+                                at=(1, 6)),
+                      FaultSpec(site="decode", kind="transient",
+                                at=(2, 7))])
+    now = [0.0]
+    engine = _mk_engine(
+        tiny_gpt, faults=plan, clock=lambda: now[0],
+        max_waiting=5, queue_high_watermark=4, degrade_patience=1,
+        enable_prefix_caching=True,
+        tenant_weights={"good": 3, "flood": 1},
+        tenant_quotas={"flood": TenantQuota(max_waiting=2,
+                                            max_resident_blocks=4)})
+    rng = np.random.RandomState(17)
+    uid = 0
+    accepted = []
+    for wave in range(6):
+        for _ in range(4):
+            tenant = "flood" if uid % 2 else "good"
+            r = Request(f"{tenant}-{uid}",
+                        list(rng.randint(1, 100, 3 + uid % 4)),
+                        max_new_tokens=3 + uid % 3, tenant=tenant,
+                        priority=uid % 2,
+                        deadline_s=(2.0 if uid % 5 == 0 else None))
+            if engine.try_add(r):
+                accepted.append(r.uid)
+            uid += 1
+        for _ in range(2):
+            had = engine.has_work
+            progressed = engine.step()
+            assert progressed or not had       # the stall contract
+            now[0] += 0.3
+        if wave % 2 and accepted:
+            engine.abort(accepted[rng.randint(len(accepted))])
+    out = engine.run(return_status=True)
+    s = engine.stats()
+    engine.check_allocator_integrity()         # the certification
+    assert {r.status for r in out.values()} <= {
+        "finished", "timeout", "failed", "rejected", "throttled",
+        "cancelled"}
+    assert s["num_cancelled"] >= 1             # aborts fired
+    assert s["num_throttled"] >= 1             # quota sheds fired
+    assert s["num_dispatch_retries"] >= 1      # faults fired
+    assert s["num_degrade_steps_down"] >= 1    # the ladder moved
+    assert sum(r.status == "finished" for r in out.values()) > 0
+    # only the flood tenant was ever throttled
+    throttled = {u for u, r in out.items() if r.status == "throttled"}
+    assert throttled and all(u.startswith("flood") for u in throttled)
+    assert not engine.has_work
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoints (ISSUE 10 satellite): a torn save is invisible
+# ---------------------------------------------------------------------------
+
+
+def test_torn_checkpoint_save_is_skipped_on_resume(tmp_path,
+                                                   monkeypatch):
+    """Kill the process between the payload write and the terminal
+    marker write: ``latest_step``/``load_checkpoint`` must resume from
+    the PREVIOUS complete step, never the torn one."""
+    from apex_tpu.utils import checkpoint as ck
+
+    ck.save_checkpoint(str(tmp_path), 1, params={"w": np.ones(3)})
+    ck.save_checkpoint(str(tmp_path), 2, params={"w": np.full(3, 2.0)})
+    assert ck.latest_step(str(tmp_path)) == 2
+
+    def crash(*a, **k):
+        raise SimulatedCrash("killed between payload and marker")
+
+    monkeypatch.setattr(ck, "_write_marker", crash)
+    with pytest.raises(SimulatedCrash):
+        ck.save_checkpoint(str(tmp_path), 3,
+                           params={"w": np.full(3, 3.0)})
+    monkeypatch.undo()
+    # the torn step-3 payload exists on disk but is invisible
+    assert (tmp_path / "step_000000003").exists()
+    assert ck.latest_step(str(tmp_path)) == 2
+    restored = ck.load_checkpoint(str(tmp_path))
+    assert restored["_step"] == 2
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.full(3, 2.0))
+    # explicitly naming the torn step raises rather than loading it
+    with pytest.raises(FileNotFoundError, match="torn"):
+        ck.load_checkpoint(str(tmp_path), step=3)
+    # a clean re-save of the same step re-commits it
+    ck.save_checkpoint(str(tmp_path), 3, params={"w": np.full(3, 9.0)})
+    assert ck.latest_step(str(tmp_path)) == 3
+    # overwrite path: the marker drops BEFORE the payload is replaced,
+    # so a crash mid-overwrite reads as incomplete too
+    monkeypatch.setattr(ck, "_write_marker", crash)
+    with pytest.raises(SimulatedCrash):
+        ck.save_checkpoint(str(tmp_path), 3,
+                           params={"w": np.zeros(3)})
+    monkeypatch.undo()
+    assert ck.latest_step(str(tmp_path)) == 2
+
+
+def test_legacy_markerless_checkpoints_stay_loadable(tmp_path):
+    """A directory written entirely by the pre-marker code (no
+    .complete files anywhere) keeps the old semantics: its steps are
+    visible and loadable — upgrading must never orphan an existing
+    run's checkpoints."""
+    from apex_tpu.utils import checkpoint as ck
+
+    ck.save_checkpoint(str(tmp_path), 4, params={"w": np.ones(2)})
+    ck.save_checkpoint(str(tmp_path), 5, params={"w": np.full(2, 5.0)})
+    # simulate a legacy directory by stripping the markers AND the
+    # marker-era sentinel
+    for f in tmp_path.glob("*.complete"):
+        f.unlink()
+    (tmp_path / ck._ERA_SENTINEL).unlink()
+    assert ck.latest_step(str(tmp_path)) == 5
+    assert ck.load_checkpoint(str(tmp_path))["_step"] == 5
+    assert ck.load_checkpoint(str(tmp_path), step=4)["_step"] == 4
+    # the first NEW save flips the directory to marker-governed:
+    # the legacy steps (marker-less) now read as unproven
+    ck.save_checkpoint(str(tmp_path), 6, params={"w": np.zeros(2)})
+    assert ck.latest_step(str(tmp_path)) == 6
 
 
 # ---------------------------------------------------------------------------

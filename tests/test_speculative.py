@@ -602,3 +602,113 @@ def test_engine_rejects_drafter_without_spec_tokens():
         InferenceEngine(model, params, EngineConfig(
             max_batch=2, block_size=8, num_blocks=16, max_prefill_len=16,
             max_seq_len=32), drafter=NgramDrafter())
+
+
+# ---------------------------------------------------------------------------
+# dynamic speculation (spec_adapt — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+class _WrongDrafter(Drafter):
+    """Adversarial drafter: proposes a constant (almost always
+    rejected) token — the low-acceptance regime spec_adapt exists
+    for."""
+
+    def __init__(self, token):
+        self._t = int(token)
+
+    def propose(self, history, max_tokens):
+        return [self._t] * max_tokens
+
+
+def _adapt_engine(model, params, cfg, **kw):
+    base = dict(max_batch=4, block_size=8, num_blocks=64,
+                max_prefill_len=16, max_seq_len=64, seed=11,
+                spec_tokens=4)
+    base.update(kw)
+    return InferenceEngine(model, params, EngineConfig(**base),
+                           drafter=_WrongDrafter(cfg.vocab_size - 1))
+
+
+def _repetitive_reqs(tag, n=4, max_new=10):
+    """Highly structured prompts: prompt-lookup acceptance ~1, the
+    regime where the adaptive cap must never move."""
+    return [Request(uid=f"{tag}{i}",
+                    prompt=[5, 6, 7, 8] * (2 + i % 2),
+                    max_new_tokens=max_new)
+            for i in range(n)]
+
+
+def test_spec_adapt_high_acceptance_bit_identical_to_static():
+    cfg, model, params = _tiny_model()
+    outs, stats = {}, {}
+    for arm, kw in {"static": dict(spec_tokens=4),
+                    "adapt": dict(spec_tokens=4, spec_adapt=True)}.items():
+        engine = _engine(model, params, **kw)
+        outs[arm] = _serve(engine, _repetitive_reqs("h"))
+        stats[arm] = engine.stats()
+    assert outs["adapt"] == outs["static"]
+    # acceptance stayed above the high threshold: the cap never moved,
+    # and the SCHEDULE matched too (same dispatch count)
+    assert stats["adapt"]["spec_cap"] == 4
+    assert stats["adapt"]["num_spec_cap_shrinks"] == 0
+    assert stats["adapt"]["draft_acceptance_rate"] > 0.8
+    assert (stats["adapt"]["num_decode_dispatches"]
+            == stats["static"]["num_decode_dispatches"])
+
+
+def test_spec_adapt_caps_out_under_rejecting_drafter():
+    cfg, model, params = _tiny_model()
+    adapt = _adapt_engine(model, params, cfg, spec_adapt=True)
+    rng = np.random.RandomState(3)
+    reqs = [Request(uid=f"c{i}", prompt=list(rng.randint(0, 128, 8)),
+                    max_new_tokens=30) for i in range(2)]
+    for r in reqs:
+        adapt.add_request(r)
+    out = adapt.run()
+    s = adapt.stats()
+    # the cap walked all the way down (4 shrink steps), so the engine
+    # stopped paying for spans it always rejects...
+    assert s["spec_cap"] == 0
+    assert s["num_spec_cap_shrinks"] == 4
+    assert s["speculation_active"] == 1     # not quarantined: adaptive
+    # ...while greedy output stays bit-identical to the non-speculative
+    # engine (the rejection rule never let a wrong draft through)
+    base = _engine(model, params)
+    for r in reqs:
+        base.add_request(Request(uid=r.uid, prompt=r.prompt,
+                                 max_new_tokens=r.max_new_tokens))
+    assert out == base.run()
+    # a static engine with the same drafter keeps drafting full spans:
+    # the adaptive engine drafted strictly less
+    static = _adapt_engine(model, params, cfg)
+    for r in reqs:
+        static.add_request(Request(uid=r.uid, prompt=r.prompt,
+                                   max_new_tokens=r.max_new_tokens))
+    static.run()
+    assert s["num_draft_tokens"] < static.stats()["num_draft_tokens"]
+
+
+def test_spec_adapt_cap_rides_snapshot_overload_section():
+    cfg, model, params = _tiny_model()
+    a = _adapt_engine(model, params, cfg, spec_adapt=True)
+    a.add_request(Request(uid="s", prompt=[3, 9, 4, 1, 7],
+                          max_new_tokens=24))
+    for _ in range(10):
+        a.step()
+    snap = a.snapshot()
+    assert snap["overload"]["spec_cap"] < 4   # mid-walk
+    # an adapting engine resumes the walk exactly...
+    b = _adapt_engine(model, params, cfg, spec_adapt=True)
+    b.restore(snap)
+    assert b.stats()["spec_cap"] == snap["overload"]["spec_cap"]
+    out_b = b.run()
+    # ...and a NON-adapting engine ignores it (it could never restore
+    # the cap — same guard shape as the ladder rung)
+    c = _adapt_engine(model, params, cfg)
+    c.restore(snap)
+    assert c.stats()["spec_cap"] == 4
+    out_c = c.run()
+    # greedy continuation identical either way (and to uninterrupted)
+    out_a = a.run()
+    assert out_b == out_a == out_c

@@ -542,6 +542,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_smoke_multistep_decode_tokens_per_sec",
         "serving_tiny_speculative_decode_tokens_per_sec",
         "serving_tiny_overload_goodput_tokens_per_sec",
+        "serving_tiny_multitenant_victim_goodput_tok_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
     }
     for r in records:
@@ -568,6 +569,20 @@ def test_bench_smoke_mode_every_section_rc0():
     assert ov["num_stalls"] == 0, ov
     assert ov["queue_depth_peak"] <= ov["max_waiting"] + ov["max_batch"]
     assert ov["status_counts"].get("finished", 0) > 0, ov
+    # the multitenant arm must have actually confined the flood (the
+    # in-section asserts do the heavy lifting; here we pin the record
+    # shape so a silently-skipped phase cannot pass)
+    mt = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_multitenant_victim_goodput_tok_per_sec"][0]
+    assert mt["flood_only_shed"] is True, mt
+    assert mt["allocator_integrity_ok"] is True, mt
+    assert mt["chaos_aborts"] > 0 and mt["chaos_retries"] > 0, mt
+    for t in ("acme", "bolt"):
+        assert mt["per_tenant"][t]["door_sheds"] == 0, mt
+        assert mt["per_tenant"][t]["throttled"] == 0, mt
+        assert mt["per_tenant"][t]["goodput_tokens"] > 0, mt
+    assert math.isfinite(mt["vs_baseline"]), mt
     # every section also leaves a wall-time/exit-status record, so a
     # section that dies is a visible "failed" entry in the artifact,
     # never just an absence
@@ -576,7 +591,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_layer_norm", "bench_fused_lamb", "bench_ddp_scaling",
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
-        "bench_train_step",
+        "bench_serving_multitenant", "bench_train_step",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

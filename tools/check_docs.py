@@ -1,0 +1,71 @@
+"""Doc-drift lint: the serving surface must stay documented.
+
+Asserts that every :class:`~apex_tpu.serving.EngineConfig` field, every
+:class:`~apex_tpu.serving.TenantQuota` field, and every top-level
+``stats()`` counter key of a live engine is NAMED somewhere in
+``docs/serving.md`` or ``docs/robustness.md`` — so the next knob or
+counter cannot land undocumented. Wired in as a tier-1 test
+(tests/test_docs_lint.py); also runnable standalone::
+
+    JAX_PLATFORMS=cpu python tools/check_docs.py   # exit 1 on drift
+
+The check is by literal name occurrence (the docs must at least SAY
+the name); it is a drift tripwire, not a prose-quality judge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_FILES = ("docs/serving.md", "docs/robustness.md")
+
+
+def _docs_text() -> str:
+    parts = []
+    for rel in DOC_FILES:
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            parts.append(f.read())
+    return "\n".join(parts)
+
+
+def collect_names():
+    """(kind, name) pairs the docs must mention. Building the stats
+    surface needs a live engine: a tiny CPU model, never dispatched —
+    ``stats()`` is readable from construction."""
+    sys.path.insert(0, REPO_ROOT)
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine,
+                                  TenantQuota)
+
+    names = [("EngineConfig field", f.name)
+             for f in dataclasses.fields(EngineConfig)]
+    names += [("TenantQuota field", f.name)
+              for f in dataclasses.fields(TenantQuota)]
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = InferenceEngine(model, params, EngineConfig(
+        max_batch=2, block_size=4, num_blocks=16, max_prefill_len=8,
+        max_seq_len=16))
+    names += [("stats() key", k) for k in engine.stats()]
+    return names
+
+
+def main():
+    text = _docs_text()
+    missing = [(kind, name) for kind, name in collect_names()
+               if name not in text]
+    for kind, name in missing:
+        print(f"UNDOCUMENTED {kind}: {name!r} appears in neither "
+              f"{' nor '.join(DOC_FILES)}", file=sys.stderr)
+    return missing
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)
