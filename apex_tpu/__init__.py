@@ -28,6 +28,10 @@ A from-scratch rebuild of the capability surface of NVIDIA Apex
   gradient accumulation + DDP + fused optimizer compiled into one
   donated-buffer dispatch, with deferred host metrics
   (docs/training.md).
+- ``apex_tpu.observability`` — request-lifecycle tracing (Perfetto
+  export), the engine flight recorder, and the metrics registry
+  (Prometheus exposition) behind ``obs=`` on the engine and
+  ``TrainLoop`` — zero-perturbation certified (docs/observability.md).
 
 Design stance (SURVEY.md §7): a functional JAX core with an apex-shaped API
 veneer — capability and knob parity with the reference, mesh/pjit-native
@@ -46,5 +50,6 @@ from apex_tpu import mlp  # noqa: F401
 from apex_tpu import reparameterization  # noqa: F401
 from apex_tpu import RNN  # noqa: F401
 from apex_tpu import fused_dense  # noqa: F401
+from apex_tpu import observability  # noqa: F401
 from apex_tpu import serving  # noqa: F401
 from apex_tpu import train  # noqa: F401

@@ -1,0 +1,122 @@
+"""Flight recorder: a bounded ring buffer of structured engine events
+(docs/observability.md).
+
+BENCH_r01/r05 died and left NOTHING — the motivation written into
+bench.py's section records, restated here for the engine itself:
+when a dispatch chain wedges, the operator needs the last N decisions
+(tick summaries, ladder transitions, quarantines, retries, cap walks),
+not a point-in-time ``stats()`` dict that says only where the counters
+ended up. The recorder is that black box: O(1) per event while enabled
+(one dict append into a ``deque(maxlen=...)``), nothing at all when
+absent, and NEVER an input to any engine decision (the
+zero-perturbation contract).
+
+``incident()`` freezes the current tail into a small bounded side
+buffer at the moment something notable happens (a quarantine, a
+device reset, a stall) — so the post-mortem survives even after the
+ring itself rolls past the event.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+# The closed vocabulary of recorder event kinds. Every kind must be
+# documented in docs/observability.md (tools/check_docs.py enforces);
+# record() rejects strays so a typo'd kind cannot silently dodge the
+# lint.
+RECORDER_EVENT_KINDS = (
+    "tick",                 # per-scheduler-tick summary (engine)
+    "ladder",               # degradation-ladder transition
+    "quarantine",           # a request terminally failed by retry exhaustion
+    "drafter_quarantine",   # the speculative drafter flipped off for good
+    "fault_retry",          # one transient-failure retry at a dispatch site
+    "spec_cap",             # spec_adapt moved the dynamic draft cap
+    "alloc_pressure",       # CacheOutOfBlocks with no lane left to preempt
+    "preempt",              # a lane preempted for pool pressure or quota
+    "shed",                 # a request shed (queue_full/throttled/rejected)
+    "snapshot",             # snapshot() taken
+    "restore",              # restore() applied
+    "device_reset",         # drain-failure crash-restore (_reset_device_state)
+    "stall",                # EngineStalledError about to raise
+    "watchdog",             # TrainLoop non-finite-loss watchdog action
+    "checkpoint",           # TrainLoop checkpoint saved
+    "train_step",           # per-train-step summary (TrainLoop)
+)
+
+_KIND_SET = frozenset(RECORDER_EVENT_KINDS)
+
+
+class FlightRecorder:
+    """Bounded ring of ``{"kind", "seq", "t", ...fields}`` event dicts.
+
+    ``seq`` is the lifetime event number (monotonic even after the ring
+    wraps — ``dropped`` = ``seq_head - len(ring)`` tells the reader how
+    much history rolled off). ``t`` comes from the injected clock (the
+    engine passes its own ``_clock``, so recorder timelines are
+    deterministic under fake clocks)."""
+
+    def __init__(self, capacity: int = 256, clock=None,
+                 max_incidents: int = 8):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._clock = time.monotonic if clock is None else clock
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self.incidents: deque = deque(maxlen=max_incidents)
+
+    def use_clock(self, clock) -> None:
+        self._clock = clock
+
+    @property
+    def dropped(self) -> int:
+        return self._seq - len(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, kind: str, **fields) -> None:
+        if kind not in _KIND_SET:
+            raise ValueError(
+                f"unknown recorder event kind {kind!r} (known: "
+                f"{RECORDER_EVENT_KINDS})")
+        # an explicit t= reuses a timestamp the caller already read
+        # (no extra clock call); otherwise stamp here
+        t = fields.pop("t", None)
+        ev = {"kind": kind, "seq": self._seq,
+              "t": float(self._clock() if t is None else t)}
+        ev.update(fields)
+        self._seq += 1
+        self._ring.append(ev)
+
+    def tail(self, n: Optional[int] = None) -> List[Dict]:
+        """The most recent ``n`` events (all, when ``n`` is None),
+        oldest first — copied dicts, safe to serialize or mutate."""
+        evs = list(self._ring)
+        if n is not None:
+            evs = evs[-n:]
+        return [dict(e) for e in evs]
+
+    def incident(self, label: str, **fields) -> Dict:
+        """Freeze the current tail as a named incident (kept in a
+        bounded side buffer so it survives ring wrap). Returns the
+        incident record."""
+        inc = {"label": label, "t": float(self._clock()),
+               "events": self.tail()}
+        inc.update(fields)
+        self.incidents.append(inc)
+        return inc
+
+    def dump(self) -> Dict[str, object]:
+        """JSON-able picture: the ring, the incidents, and the drop
+        accounting — the recorder half of ``Observability.dump()``."""
+        return {
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "events": self.tail(),
+            "incidents": [dict(i) for i in self.incidents],
+        }

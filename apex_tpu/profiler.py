@@ -24,6 +24,8 @@ from typing import Optional
 
 import jax
 
+from apex_tpu.observability.metrics import percentile
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False):
@@ -54,7 +56,14 @@ class StepTimer:
         for batch in data:
             out = step(...)
             timer.tick(out)          # blocks on out, records dt
-        print(timer.summary())       # {mean_ms, p50_ms, min_ms, steps}
+        print(timer.summary())       # {mean_ms, p50/p90/p99_ms, ...}
+
+    Percentiles come from the shared interpolating helper
+    (:func:`apex_tpu.observability.metrics.percentile` — the one
+    bench.py's TTFT/ITL reporting and the metrics histograms use), so
+    a p50 here means the same thing everywhere. (The previous median
+    was ``ts[n // 2]`` — the upper neighbor, not the median, for
+    even n.)
     """
 
     def __init__(self, warmup: int = 2):
@@ -83,7 +92,9 @@ class StepTimer:
         return {
             "steps": n,
             "mean_ms": 1e3 * sum(ts) / n,
-            "p50_ms": 1e3 * ts[n // 2],
+            "p50_ms": 1e3 * percentile(ts, 50),
+            "p90_ms": 1e3 * percentile(ts, 90),
+            "p99_ms": 1e3 * percentile(ts, 99),
             "min_ms": 1e3 * ts[0],
             "max_ms": 1e3 * ts[-1],
         }

@@ -153,6 +153,21 @@ is_last)`` delivery; a disconnect callback maps onto ``abort``).
 Tenancy is pure scheduling: sampling stays arrival-keyed, so outputs
 are invariant to tenant assignment, and uniform-tenant traffic is
 bit-identical to the pre-tenancy engine.
+
+**Observability** (docs/observability.md): pass an
+:class:`~apex_tpu.observability.Observability` via ``obs=`` and the
+engine narrates itself — per-request span timelines (Perfetto
+exportable), a flight-recorder ring of tick/ladder/quarantine/retry
+events whose tail rides :class:`EngineStalledError` and the crash-dump
+file, and latency histograms (TTFT, inter-token, dispatch service,
+queue wait) with Prometheus exposition, merged by ``stats(deep=True)``.
+The contract is ZERO perturbation: observers consume events through the
+engine's injectable ``_clock`` and never feed a decision, so outputs
+with observability attached are bit-identical to without (tested across
+greedy/sampled x speculative/not x preemption x snapshot/restore).
+Observer state is excluded from the snapshot fingerprint; recorder and
+trace tails ride ``snapshot()`` only as an audit section ``restore()``
+never reloads.
 """
 
 from __future__ import annotations
@@ -331,11 +346,16 @@ class EngineStalledError(RuntimeError):
     """``has_work`` is true but a full ``step()`` made no progress —
     no admission, prefill chunk, decode dispatch, drain, expiry,
     preemption, or quarantine. The scheduler would spin forever;
-    ``engine_stats`` carries ``stats()`` at the stall for diagnosis."""
+    ``engine_stats`` carries ``stats()`` at the stall for diagnosis;
+    ``recorder_tail`` the flight recorder's last events when an
+    :class:`~apex_tpu.observability.Observability` was attached (None
+    otherwise) — the stall ships its own post-mortem."""
 
-    def __init__(self, message: str, stats: Dict[str, float]):
+    def __init__(self, message: str, stats: Dict[str, object],
+                 recorder_tail=None):
         super().__init__(f"{message} (stats: {stats})")
         self.engine_stats = stats
+        self.recorder_tail = recorder_tail
 
 
 @dataclasses.dataclass(frozen=True)
@@ -897,7 +917,7 @@ class InferenceEngine:
     """
 
     def __init__(self, model, params, config: EngineConfig, *,
-                 drafter=None, faults=None, clock=None):
+                 drafter=None, faults=None, clock=None, obs=None):
         cfg = model.cfg
         self.model = model
         self.params = params
@@ -921,6 +941,18 @@ class InferenceEngine:
                     f"train loop's watchdog owns nan handling)")
         # deadline clock, injectable so TTL tests are deterministic
         self._clock = time.monotonic if clock is None else clock
+        # observability (docs/observability.md): tracer + flight
+        # recorder + metrics, all OUTPUT-only — no engine decision ever
+        # reads observer state (the zero-perturbation contract), and
+        # every observer timestamp comes from the engine's own clock so
+        # traces are deterministic under fake clocks. None = off, at
+        # zero cost on the hot paths.
+        self._obs = obs
+        if obs is not None:
+            obs.bind_engine(self._clock)
+        # (dispatch t0, dispatch seq) of the in-flight decode, tracked
+        # only while an observer wants the dispatch->drain trace span
+        self._pending_obs = None
         self._chunk = (config.prefill_chunk if config.prefill_chunk
                        is not None else config.max_prefill_len)
         # speculative decoding: the drafter defaults to prompt-lookup;
@@ -1248,6 +1280,8 @@ class InferenceEngine:
         # docs/robustness.md, isolation), then the engine-wide bound
         reason = self._door_throttle_reason(request)
         if reason is not None:
+            if self._obs is not None:
+                self._obs.note_shed(uid, "throttled", queued=False)
             self.finished[uid] = []
             self._set_status(request, "throttled")
             self._num_throttled += 1
@@ -1259,16 +1293,28 @@ class InferenceEngine:
         if (self.config.max_waiting is not None
                 and len(self.waiting) >= self.config.max_waiting):
             self._num_rejected_queue_full += 1
+            if self._obs is not None:
+                # a door shed: the request never entered the engine
+                # and gets NO terminal status, but the trace must
+                # still show the refusal
+                self._obs.note_shed(uid, "queue_full", queued=False)
             raise QueueFullError(
                 f"request {uid!r} rejected: waiting queue is at "
                 f"max_waiting ({self.config.max_waiting})")
         self._live_uids.add(uid)
         if request.deadline_s is not None:
             self._deadline[request.uid] = self._clock() + request.deadline_s
+        enq_t = self._clock()
         self.waiting.append(_QueueEntry(request=request,
                                         arrival=self._arrival_count,
-                                        enq_t=self._clock(),
+                                        enq_t=enq_t,
                                         enq_tick=self._num_ticks))
+        if self._obs is not None:
+            # reuse the engine-read timestamp: observation adds no
+            # clock call of its own here
+            self._obs.note_enqueue(uid, tenant=request.tenant,
+                                   priority=request.priority,
+                                   prompt_len=n, t=enq_t)
         self._arrival_count += 1
         self._queue_depth_peak = max(self._queue_depth_peak,
                                      len(self.waiting))
@@ -1471,14 +1517,17 @@ class InferenceEngine:
             jnp.asarray(arrivals))
         return temp, top_k, top_p, jnp.asarray(eos), keys
 
-    def _set_status(self, request: Request, status: str) -> None:
+    def _set_status(self, request: Request, status: str,
+                    lane: Optional[int] = None) -> None:
         """Record a terminal status: in the drain-able ``statuses`` map,
         on the request object itself, out of the deadline watch and
         the live-uid set, into the tenant's status tally, and onto the
         stream as the ``(uid, -1, True)`` terminal sentinel (every
         terminal transition funnels through here — the uid is
         re-usable from this point, and stream consumers learn
-        terminality exactly once)."""
+        terminality exactly once). ``lane`` is the slot the request
+        exited from (None for queue-side exits) — trace-only context:
+        the terminal event closes the lane's residency span."""
         self.statuses[request.uid] = status
         object.__setattr__(request, "status", status)
         self._deadline.pop(request.uid, None)
@@ -1486,6 +1535,8 @@ class InferenceEngine:
         tally = self._tenant_status.setdefault(request.tenant, {})
         tally[status] = tally.get(status, 0) + 1
         self._stream.append((request.uid, -1, True))
+        if self._obs is not None:
+            self._obs.note_terminal(request.uid, status, lane=lane)
         self._prune_tenant_if_idle(request.tenant)
 
     def _tenant_is_listed(self, tenant: str) -> bool:
@@ -1557,16 +1608,22 @@ class InferenceEngine:
         # idle-tenant pruning must not see the finishing slot as a
         # live resident
         self.slots[idx] = None
-        self._set_status(slot.request, status)
+        self._set_status(slot.request, status, lane=idx)
         self._invalidate_lanes()
 
     def _quarantine_slot(self, idx: int) -> None:
         """Terminal-fail one lane's request after its dispatches
         exhausted every retry: same release path as a normal finish,
         status ``"failed"``, tokens already emitted kept. The engine —
-        and every other lane — keeps serving."""
+        and every other lane — keeps serving. With a recorder attached
+        the quarantine freezes the current event tail as an incident —
+        the poisoned dispatch's post-mortem outlives the ring."""
+        uid = self.slots[idx].request.uid
         self._finish(idx, status="failed")
         self._num_quarantines += 1
+        if self._obs is not None:
+            self._obs.record("quarantine", uid=uid, lane=idx)
+            self._obs.incident("quarantine", uid=uid)
 
     def _expire_deadlines(self, include_started: bool) -> int:
         """Finish every request past its deadline with status
@@ -1614,14 +1671,26 @@ class InferenceEngine:
         live = sorted(((s.admit_seq, i)
                        for i, s in enumerate(self.slots)
                        if s is not None), reverse=True)
+        if self._obs is not None:
+            self._obs.record("device_reset", residents=len(live),
+                             fetch_failures=self._fetch_failures)
+            self._obs.incident("device_reset")
         for _, i in live:    # youngest first, so the oldest lands at head
             slot = self.slots[i]
+            requeue_t = self._clock()
             self.waiting.appendleft(_QueueEntry(
                 request=slot.request, arrival=slot.entry.arrival,
                 generated=self._resume_tokens(slot),
-                enq_t=self._clock(), enq_tick=self._num_ticks,
+                enq_t=requeue_t, enq_tick=self._num_ticks,
                 drr_charged=True))
             self.slots[i] = None
+            if self._obs is not None:
+                self._obs.note_preempt(slot.request.uid, i,
+                                       reason="device_reset", t=requeue_t)
+                self._obs.note_enqueue(slot.request.uid,
+                                       tenant=slot.request.tenant,
+                                       priority=slot.request.priority,
+                                       requeue=True, t=requeue_t)
         # requeues are the one path that pushes the queue past
         # max_waiting (by at most max_batch) — the exact overshoot the
         # peak metric exists to expose, sampled here before admission
@@ -1647,6 +1716,9 @@ class InferenceEngine:
 
         def count(attempt):
             self._num_dispatch_retries += 1
+            if self._obs is not None:
+                self._obs.record("fault_retry", site=site,
+                                 attempt=attempt)
 
         out, _ = guarded_call(
             fn, *args, plan=self.faults, site=site,
@@ -1662,16 +1734,22 @@ class InferenceEngine:
         return dt if prev is None else (1.0 - _EWMA_ALPHA) * prev \
             + _EWMA_ALPHA * dt
 
-    def _record_token(self, idx: int, token: int) -> None:
+    def _record_token(self, idx: int, token: int,
+                      t_vis: Optional[float] = None) -> None:
         """Append a sampled token to a slot, finishing on EOS/max-len.
         The single funnel for FRESH tokens (resumed histories bypass
         it), so it also feeds the stream-event buffer and the tenant's
-        delivered-token ledger exactly once per token."""
+        delivered-token ledger exactly once per token. ``t_vis`` is
+        the host-visibility timestamp the caller already read (prefill
+        fetch end / drain fetch end) — the observer reuses it instead
+        of reading the clock again."""
         slot = self.slots[idx]
         slot.generated.append(token)
         slot.last_token = token
         req = slot.request
         self._stream.append((req.uid, int(token), False))
+        if self._obs is not None:
+            self._obs.note_token(req.uid, t=t_vis)
         self._note_tenant_tokens(req.tenant, 1)
         if ((req.eos_token_id is not None and token == req.eos_token_id)
                 or len(slot.generated) >= req.max_new_tokens):
@@ -1780,21 +1858,25 @@ class InferenceEngine:
             skips_prefill=bool(entry.generated) and uncached_tail <= 0)
         if est is None or self._clock() + est <= dl:
             return False
+        if self._obs is not None:
+            self._obs.note_shed(req.uid, "rejected", queued=True)
         self.waiting.popleft(below=below, skip=skip)  # exactly this entry
         self.finished[req.uid] = list(entry.generated)
         self._set_status(req, "rejected")
         self._num_rejected_infeasible += 1
         return True
 
-    def _note_admitted_wait(self, entry: _QueueEntry) -> None:
+    def _note_admitted_wait(self, entry: _QueueEntry):
         wait_ticks = self._num_ticks - entry.enq_tick
-        wait_s = max(0.0, self._clock() - entry.enq_t)
+        now = self._clock()
+        wait_s = max(0.0, now - entry.enq_t)
         self._queue_wait_count += 1
         self._queue_wait_ticks_sum += wait_ticks
         self._queue_wait_ticks_max = max(self._queue_wait_ticks_max,
                                          wait_ticks)
         self._queue_wait_s_sum += wait_s
         self._queue_wait_s_max = max(self._queue_wait_s_max, wait_s)
+        return wait_s, now
 
     def _admit(self) -> int:
         """Move waiting requests into free lanes while the pool can
@@ -1867,6 +1949,10 @@ class InferenceEngine:
                             # block — shed instead of wedging its lane
                             # (unreachable for door-validated requests,
                             # kept as the no-deadlock backstop)
+                            if self._obs is not None:
+                                self._obs.note_shed(entry.request.uid,
+                                                    "throttled",
+                                                    queued=True)
                             self.waiting.popleft(below=below, skip=skip)
                             self.finished[entry.request.uid] = \
                                 list(entry.generated)
@@ -1889,7 +1975,11 @@ class InferenceEngine:
                     return admitted
                 self.allocator.acquire(matched, tenant=tenant)
                 self.waiting.popleft(below=below, skip=skip)
-                self._note_admitted_wait(entry)
+                wait_s, admit_t = self._note_admitted_wait(entry)
+                if self._obs is not None:
+                    self._obs.note_admit(entry.request.uid, idx, wait_s,
+                                         cached_blocks=len(matched),
+                                         t=admit_t)
                 blocks = matched + (self.allocator.alloc(tail,
                                                          tenant=tenant)
                                     if tail else [])
@@ -1948,7 +2038,7 @@ class InferenceEngine:
         # service time, and folding them in would inflate the
         # feasibility gate's contention-free lower bound into
         # over-shedding after one transient fault
-        attempt_s = [0.0]
+        attempt_s = [0.0, 0.0]   # [dt, t0] of the successful attempt
 
         def attempt():
             # dispatch AND fetch inside the retry unit — EVERY chunk,
@@ -1977,6 +2067,7 @@ class InferenceEngine:
                 self._request_key(slot.entry), temp, top_k, top_p)
             tok0 = int(tok[0])      # the fetch is part of service time
             attempt_s[0] = self._clock() - t0
+            attempt_s[1] = t0
             return cache, tok0
 
         try:
@@ -1989,6 +2080,9 @@ class InferenceEngine:
         self._ewma_prefill_s = self._ewma_update(self._ewma_prefill_s,
                                                  attempt_s[0])
         self._num_prefill_chunks += 1
+        if self._obs is not None:
+            self._obs.note_prefill_chunk(slot.request.uid, idx, start,
+                                         end, attempt_s[1], attempt_s[0])
         slot.prefill_pos = end
         slot.context_len = max(slot.context_len, end)
         self._register_full_blocks(slot)
@@ -2002,7 +2096,8 @@ class InferenceEngine:
                 slot.generated = list(slot.entry.generated)
                 slot.last_token = slot.generated[-1]
             else:
-                self._record_token(idx, tok0)
+                self._record_token(idx, tok0,
+                                   t_vis=attempt_s[1] + attempt_s[0])
         return True
 
     # -- speculative drafting (docs/serving.md) ----------------------------
@@ -2079,6 +2174,9 @@ class InferenceEngine:
                 # bug: degrade to non-speculative decoding, permanently
                 self._drafter_ok = False
                 self._num_drafter_quarantines += 1
+                if self._obs is not None:
+                    self._obs.record("drafter_quarantine")
+                    self._obs.incident("drafter_quarantine")
                 return
             clean: List[int] = []
             for t in list(props)[:cap]:
@@ -2141,18 +2239,20 @@ class InferenceEngine:
         idx = max(cand, key=self._yield_key)
         tally = self._tenant_preemptions
         tally[tenant] = tally.get(tenant, 0) + 1
-        return self._preempt_slot(idx)
+        return self._preempt_slot(idx, reason="quota")
 
-    def _preempt_slot(self, idx: int) -> bool:
+    def _preempt_slot(self, idx: int,
+                      reason: str = "pool_pressure") -> bool:
         slot = self.slots[idx]
         gen = self._resume_tokens(slot)
         # deepest-first, same as _finish: keep evictable chains matchable
         self.allocator.free(list(reversed(slot.blocks)),
                             tenant=slot.request.tenant)
+        requeue_t = self._clock()
         self.waiting.appendleft(_QueueEntry(request=slot.request,
                                             arrival=slot.entry.arrival,
                                             generated=gen,
-                                            enq_t=self._clock(),
+                                            enq_t=requeue_t,
                                             enq_tick=self._num_ticks,
                                             drr_charged=True))
         # sample the peak at the requeue itself — admission may
@@ -2162,6 +2262,13 @@ class InferenceEngine:
         self.slots[idx] = None
         self._invalidate_lanes()
         self._num_preemptions += 1
+        if self._obs is not None:
+            self._obs.note_preempt(slot.request.uid, idx, reason=reason,
+                                   t=requeue_t)
+            self._obs.note_enqueue(slot.request.uid,
+                                   tenant=slot.request.tenant,
+                                   priority=slot.request.priority,
+                                   requeue=True, t=requeue_t)
         return True
 
     def _ensure_decode_blocks(self) -> None:
@@ -2218,6 +2325,11 @@ class InferenceEngine:
                         self._invalidate_tables()
                     except CacheOutOfBlocks:
                         if not self._preempt_for(i):
+                            if self._obs is not None:
+                                self._obs.record(
+                                    "alloc_pressure",
+                                    uid=slot.request.uid,
+                                    free=self.allocator.num_free)
                             raise CacheOutOfBlocks(
                                 f"request {slot.request.uid!r} cannot grow "
                                 f"past {slot.context_len} cached tokens: "
@@ -2240,6 +2352,10 @@ class InferenceEngine:
                         1, tenant=slot.request.tenant)[0]
                 except CacheOutOfBlocks:
                     if not self._preempt_for(i):
+                        if self._obs is not None:
+                            self._obs.record(
+                                "alloc_pressure", uid=slot.request.uid,
+                                free=self.allocator.num_free)
                         raise CacheOutOfBlocks(
                             f"request {slot.request.uid!r}: cannot "
                             "copy-on-write a shared block, pool "
@@ -2335,6 +2451,9 @@ class InferenceEngine:
             self._pending = (toks, list(active),
                              {i: self.slots[i].request.uid
                               for i in active})
+            if self._obs is not None:
+                self._pending_obs = (self._clock(),
+                                     self._num_decode_dispatches)
             return
 
     def _drain_decode(self) -> bool:
@@ -2362,6 +2481,7 @@ class InferenceEngine:
             return False
         toks, active, uids = self._pending
         self._pending = None
+        pending_obs, self._pending_obs = self._pending_obs, None
         # the decode EWMA times THIS fetch block only — the remaining
         # in-flight device time at drain. The full launch->drain span
         # would fold caller inter-tick pauses and host scheduling into
@@ -2393,17 +2513,31 @@ class InferenceEngine:
                 self._fetch_failures = 0
             else:
                 self._num_dispatch_retries += 1
+                if self._obs is not None:
+                    self._obs.record("fault_retry", site="decode_drain",
+                                     attempt=self._fetch_failures)
                 if self.config.retry_backoff_s > 0.0:
                     time.sleep(self.config.retry_backoff_s
                                * (2 ** (self._fetch_failures - 1)))
             self._reset_device_state()
             return True
         self._fetch_failures = 0
+        t_end = self._clock()
         self._ewma_decode_s = self._ewma_update(
-            self._ewma_decode_s, self._clock() - t_fetch)
+            self._ewma_decode_s, t_end - t_fetch)
         # each lane's emitted tokens are its non-sentinel prefix (lanes
         # freeze permanently mid-scan, and real token ids are >= 0)
         counts = (toks >= 0).sum(axis=1)
+        if self._obs is not None and pending_obs is not None:
+            # trace the dispatch BEFORE replaying its tokens, so each
+            # request's timeline reads decode -> drain -> terminal in
+            # emission order; aborted/re-filled lanes (uid mismatch)
+            # are excluded exactly as the replay below excludes them
+            self._obs.note_decode_drained(
+                pending_obs[1], pending_obs[0], t_end, t_end - t_fetch,
+                [(uids[i], i, int(counts[i])) for i in active
+                 if self.slots[i] is not None
+                 and self.slots[i].request.uid == uids[i]])
         spec = self.config.spec_tokens > 0
         bs = self.config.block_size
         drafted_this = accepted_this = 0
@@ -2422,7 +2556,7 @@ class InferenceEngine:
                 slot.tokens.append(slot.last_token)   # its K/V landed
                 slot.context_len += 1
                 self._register_full_blocks(slot)
-                self._record_token(i, int(toks[i, j]))
+                self._record_token(i, int(toks[i, j]), t_vis=t_end)
                 if self.slots[i] is None:
                     break
             self._num_tokens_decoded += n
@@ -2481,10 +2615,18 @@ class InferenceEngine:
                     and self._spec_cap > 0):
                 self._spec_cap -= 1
                 self._num_spec_cap_shrinks += 1
+                if self._obs is not None:
+                    self._obs.record("spec_cap", cap=self._spec_cap,
+                                     direction="shrink",
+                                     ewma=self._spec_accept_ewma)
             elif (self._spec_accept_ewma > self.config.spec_accept_high
                     and self._spec_cap < self.config.spec_tokens):
                 self._spec_cap += 1
                 self._num_spec_cap_restores += 1
+                if self._obs is not None:
+                    self._obs.record("spec_cap", cap=self._spec_cap,
+                                     direction="restore",
+                                     ewma=self._spec_accept_ewma)
         return True
 
     # -- the degradation ladder (docs/robustness.md) -----------------------
@@ -2543,6 +2685,9 @@ class InferenceEngine:
                 self._pressure_streak = 0
                 self._num_degrade_steps_down += 1
                 transition = True
+                if self._obs is not None:
+                    self._obs.record("ladder", direction="down",
+                                     level=self._degradation_level)
         else:
             self._clear_streak += 1
             self._pressure_streak = 0
@@ -2552,6 +2697,9 @@ class InferenceEngine:
                 self._clear_streak = 0
                 self._num_degrade_steps_up += 1
                 transition = True
+                if self._obs is not None:
+                    self._obs.record("ladder", direction="up",
+                                     level=self._degradation_level)
         if self._degradation_level >= 2:
             self._num_degrade_flushed_blocks += \
                 self.allocator.flush_evictable()
@@ -2606,10 +2754,15 @@ class InferenceEngine:
                 entry = self.waiting.head()
                 need = blocks_needed(len(entry.request.prompt) + 1,
                                      self.config.block_size)
+                if self._obs is not None:
+                    self._obs.record("alloc_pressure",
+                                     uid=entry.request.uid, need=need)
                 raise CacheOutOfBlocks(
                     f"request {entry.request.uid!r} needs {need} blocks "
                     f"to admit but only {self.allocator.num_blocks} exist "
                     "in the pool")
+            self._record_tick(admitted, chunked, synced, expired, shed,
+                              made)
             return made
         pre_preempt = self._num_preemptions
         pre_quarantine = self._num_quarantines
@@ -2626,9 +2779,30 @@ class InferenceEngine:
                       if s is not None and s.started]
         if active:
             self._dispatch_decode(active)
-        return bool(made or self._pending is not None
-                    or self._num_preemptions > pre_preempt
-                    or self._num_quarantines > pre_quarantine)
+        progressed = bool(made or self._pending is not None
+                          or self._num_preemptions > pre_preempt
+                          or self._num_quarantines > pre_quarantine)
+        self._record_tick(admitted, chunked, synced, expired, shed,
+                          progressed)
+        return progressed
+
+    def _record_tick(self, admitted: int, chunked: bool, synced: bool,
+                     expired: int, shed: int, progress: bool) -> None:
+        """One flight-recorder ``tick`` summary per ``step()`` — the
+        rolling narration of what the scheduler decided, O(1) per tick
+        and only when a recorder is attached."""
+        obs = self._obs
+        if obs is None or obs.recorder is None:
+            return
+        obs.record(
+            "tick", tick=self._num_ticks, admitted=int(admitted),
+            chunked=bool(chunked), drained=bool(synced),
+            expired=int(expired), shed=int(shed),
+            progress=bool(progress),
+            active=sum(s is not None for s in self.slots),
+            waiting=len(self.waiting),
+            blocks_free=self.allocator.num_free,
+            level=self._degradation_level)
 
     @property
     def has_work(self) -> bool:
@@ -2651,12 +2825,26 @@ class InferenceEngine:
         contract in docs/serving.md; the same status is written onto
         each ``Request.status``). If a full step makes no progress
         while work remains, raises :class:`EngineStalledError` with
-        ``stats()`` attached instead of spinning forever."""
-        while self.has_work:
-            if not self.step():
-                raise EngineStalledError(
-                    "engine has work but a full step made no progress",
-                    self.stats())
+        ``stats()`` attached instead of spinning forever (plus the
+        flight recorder's tail when an observer is attached — and any
+        exception escaping the drive loop writes the observer's crash
+        dump to its ``crash_dump_path`` before propagating, so the
+        next dead bench section ships its own post-mortem)."""
+        try:
+            while self.has_work:
+                if not self.step():
+                    tail = None
+                    if self._obs is not None:
+                        self._obs.record("stall")
+                        if self._obs.recorder is not None:
+                            tail = self._obs.recorder.tail()
+                    raise EngineStalledError(
+                        "engine has work but a full step made no "
+                        "progress", self.stats(), recorder_tail=tail)
+        except Exception as e:
+            if self._obs is not None:
+                self._obs.crash_dump(e)
+            raise
         out, self.finished = self.finished, {}
         statuses, self.statuses = self.statuses, {}
         # run() IS the non-streaming consumption path: the terminal
@@ -2764,7 +2952,9 @@ class InferenceEngine:
         for entry in self.waiting:
             requests.append(self._entry_record(entry, now))
         self._num_snapshots += 1
-        return {
+        if self._obs is not None:
+            self._obs.record("snapshot", requests=len(requests))
+        snap = {
             "version": 1,
             "config": self._config_fingerprint(),
             "arrival_count": int(self._arrival_count),
@@ -2823,6 +3013,21 @@ class InferenceEngine:
                 for _, i in live},
             "allocator": self.allocator.snapshot_state(),
         }
+        if self._obs is not None:
+            # AUDIT-ONLY, like the block tables: the flight-recorder
+            # tail and trace depth ride along for post-mortems, and
+            # restore() deliberately never reads this section —
+            # observer state must not influence a restored engine
+            # (the zero-perturbation contract), and it is excluded
+            # from the config fingerprint for the same reason
+            audit = {"audit_only": True}
+            if self._obs.recorder is not None:
+                audit["recorder_tail"] = self._obs.recorder.tail()
+                audit["recorder_dropped"] = self._obs.recorder.dropped
+            if self._obs.tracer is not None:
+                audit["trace_events"] = len(self._obs.tracer)
+            snap["observability"] = audit
+        return snap
 
     def restore(self, snap: Dict[str, object]) -> None:
         """Load a :meth:`snapshot` into a FRESHLY constructed engine
@@ -2872,6 +3077,14 @@ class InferenceEngine:
                 generated=[int(t) for t in rec["generated"]],
                 enq_t=now, enq_tick=self._num_ticks,
                 drr_charged=bool(rec.get("drr_charged", False))))
+            if self._obs is not None:
+                # anchor the restored request's timeline (requeue, not
+                # enqueue: no fresh-request counter, no TTFT state —
+                # its true submit time belongs to the dead process)
+                self._obs.note_enqueue(req.uid, tenant=req.tenant,
+                                       priority=req.priority,
+                                       prompt_len=len(req.prompt),
+                                       requeue=True, t=now)
         self._arrival_count = int(snap["arrival_count"])
         self.finished.update({uid: [int(t) for t in toks]
                               for uid, toks in snap["finished"].items()})
@@ -2937,7 +3150,11 @@ class InferenceEngine:
         for t, n in (tenancy.get("preemptions") or {}).items():
             self._tenant_preemptions[t] = int(n)
         self._tenant_seen.update(tenancy.get("seen", ()))
+        # the snapshot's "observability" audit section (if any) is
+        # deliberately NOT read: observer state never shapes behavior
         self._num_restores += 1
+        if self._obs is not None:
+            self._obs.record("restore", requests=len(snap["requests"]))
 
     def check_allocator_integrity(self) -> None:
         """Cross-check the allocator against the engine's own
@@ -2963,10 +3180,19 @@ class InferenceEngine:
             expected_refcounts=expected,
             expected_tenant_refs=expected_tenants)
 
-    def stats(self) -> Dict[str, float]:
+    def stats(self, deep: bool = False) -> Dict[str, object]:
+        """The observability counters. Honest typing note: despite its
+        long life as ``Dict[str, float]``, the dict has carried the
+        NESTED per-tenant ledger (``"tenants"``) since PR 9 — the
+        value type is ``object``; flatten nested sections with
+        :func:`apex_tpu.observability.flatten_stats` when a scalar
+        map is needed. ``deep=True`` additionally merges the attached
+        observer's section (metric values, recorder/trace depths)
+        under ``"observability"`` — absent entirely when no observer
+        is attached or at the default ``deep=False``."""
         alloc = self.allocator
         lookups = self._prefix_lookup_blocks
-        return {
+        out = {
             "prefill_compilations": self._prefill._cache_size(),
             "decode_compilations": self._decode._cache_size(),
             "num_prefills": self._num_prefills,
@@ -3059,6 +3285,9 @@ class InferenceEngine:
             "stream_backlog": len(self._stream),
             "tenants": self._tenant_section(),
         }
+        if deep and self._obs is not None:
+            out["observability"] = self._obs.deep_stats()
+        return out
 
     def _tenant_section(self) -> Dict[str, Dict[str, object]]:
         """``stats()["tenants"]``: one row per tenant ever seen —

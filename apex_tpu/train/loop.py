@@ -115,16 +115,25 @@ class TrainLoop:
     :class:`WatchdogConfig`), ``checkpoint_dir`` + ``checkpoint_every``
     (periodic :func:`apex_tpu.utils.checkpoint.save_train_state` every
     N completed steps — each save host-syncs the full state, so pick N
-    against your step time).
+    against your step time), and ``obs`` (an
+    :class:`~apex_tpu.observability.Observability` —
+    docs/observability.md): a per-step host-span histogram, step/
+    retry/non-finite counters with Prometheus exposition via
+    ``stats(deep=True)``, and watchdog/checkpoint events into the
+    flight recorder. Observation-only, like the engine's: nothing the
+    loop computes ever reads observer state.
     """
 
     def __init__(self, train_step, state, *, faults=None,
                  max_retries: int = 2, retry_backoff_s: float = 0.0,
                  watchdog: Optional[WatchdogConfig] = None,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 0):
+                 checkpoint_every: int = 0, obs=None):
         self._train_step = train_step
         self.state = state
+        self._obs = obs
+        if obs is not None:
+            obs.bind_train()
         self._pending = None  # last step's unfetched device metrics
         self._faults = faults
         self._max_retries = int(max_retries)
@@ -155,8 +164,15 @@ class TrainLoop:
         :class:`~apex_tpu.utils.faults.DispatchFailedError`. The
         watchdog inspects every fetched metrics dict and may raise
         :class:`NonFiniteLossError` from here (halt rung)."""
+        obs = self._obs
+        t0 = obs.now() if obs is not None else 0.0
+
         def count(attempt):
             self._retries += 1
+            if obs is not None:
+                obs.record("fault_retry", site="train_step",
+                           attempt=attempt)
+                obs.inc("retries")
 
         (new_state, metrics), nan_hit = guarded_call(
             self._train_step, self.state, batch, plan=self._faults,
@@ -172,6 +188,15 @@ class TrainLoop:
                     else "loss"] = float("nan")
         prev, self._pending = self._pending, metrics
         out = None if prev is None else _to_host(prev)
+        if obs is not None:
+            # the deferred-metrics host span: this step's dispatch plus
+            # the PREVIOUS step's fetch — exactly what the loop's
+            # overlap design is supposed to keep short
+            dt = obs.now() - t0
+            obs.inc("steps")
+            obs.observe("step", dt)
+            obs.record("train_step", step=self._steps_dispatched,
+                       host_span_s=dt)
         if out is not None:
             self._observe(out, raise_on_halt=True)
         self._maybe_checkpoint()
@@ -241,17 +266,27 @@ class TrainLoop:
             return
         self._nonfinite_run += 1
         self._watchdog_trips += 1
+        obs = self._obs
+        if obs is not None:
+            obs.inc("nonfinite")
         run = self._nonfinite_run
         if run <= wd.skip_steps:
             self._watchdog_skips += 1
+            if obs is not None:
+                obs.record("watchdog", action="skip", run=run)
         elif run <= wd.skip_steps + wd.rescale_steps:
             self._watchdog_rescales += 1
+            if obs is not None:
+                obs.record("watchdog", action="rescale", run=run)
             self._rescale(wd)
         elif raise_on_halt:
             # counted only when actually raised: a drain (already
             # unwinding) may observe one more halt-level loss, which is
             # the same failure, not a second halt
             self._watchdog_halts += 1
+            if obs is not None:
+                obs.record("watchdog", action="halt", run=run)
+                obs.incident("watchdog_halt", run=run)
             raise NonFiniteLossError(
                 f"loss non-finite for {run} consecutive steps "
                 f"(through {wd.skip_steps} skips and "
@@ -287,6 +322,10 @@ class TrainLoop:
         self._checkpoints_saved += 1
         self._last_checkpoint_step = int(
             np.asarray(jax.device_get(self.state.step)))
+        if self._obs is not None:
+            self._obs.inc("checkpoints")
+            self._obs.record("checkpoint",
+                             step=self._last_checkpoint_step, path=path)
         return path
 
     def _maybe_checkpoint(self) -> None:
@@ -297,10 +336,14 @@ class TrainLoop:
 
     # -- observability -----------------------------------------------------
 
-    def stats(self) -> Dict[str, Any]:
+    def stats(self, deep: bool = False) -> Dict[str, Any]:
         """Failure-path counters (docs/robustness.md): everything the
-        chaos suite asserts nonzero rides here."""
-        return {
+        chaos suite asserts nonzero rides here. ``deep=True`` merges
+        the attached observer's section (metric values, recorder
+        depth) under ``"observability"`` — the same contract as
+        ``InferenceEngine.stats(deep=True)``
+        (docs/observability.md)."""
+        out = {
             "steps_dispatched": self._steps_dispatched,
             "dispatch_retries": self._retries,
             "watchdog_nonfinite": self._watchdog_trips,
@@ -310,3 +353,6 @@ class TrainLoop:
             "checkpoints_saved": self._checkpoints_saved,
             "last_checkpoint_step": self._last_checkpoint_step,
         }
+        if deep and self._obs is not None:
+            out["observability"] = self._obs.deep_stats()
+        return out

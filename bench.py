@@ -846,6 +846,7 @@ def bench_serving(fast=False):
     config (flow check, metric named accordingly). ``fast=True`` is the
     tier-1 smoke shape (smallest workload, same code paths)."""
     from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import flatten_stats as _flatten_stats
     from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
                                   SamplingParams)
 
@@ -988,10 +989,10 @@ def bench_serving(fast=False):
         "prefix_overlap_0pct": arm0,
         "prefix_overlap_90pct": arm90,
         "scheduler_stats": {
-            # scalar counters only; the nested per-tenant ledger
-            # ("tenants") has its own bench arm
+            # the sanctioned flattener (docs/observability.md); the
+            # nested per-tenant ledger is excluded — it has its own arm
             k: (round(v, 4) if isinstance(v, float) else int(v))
-            for k, v in s90.items() if not isinstance(v, dict)
+            for k, v in _flatten_stats(s90, exclude=("tenants",)).items()
         },
     }
 
@@ -1421,8 +1422,12 @@ def bench_serving_overload(fast=False):
             <= ecfg.max_waiting + ecfg.max_batch), stats
     assert status_counts.get("finished", 0) > 0, status_counts
 
+    # the ONE shared percentile helper (linear interpolation, same
+    # rule as StepTimer and the obs histograms — docs/observability.md)
+    from apex_tpu.observability import percentile
+
     def pct(xs, q):
-        return float(np.percentile(xs, q)) if xs else 0.0
+        return percentile(xs, q) if xs else 0.0
 
     print(f"# serving overload: {len(trace)} offered "
           f"({shed_at_door} shed at door) over {tick} ticks | "
@@ -1606,8 +1611,10 @@ def bench_serving_multitenant(fast=False):
         ttft = {u: first[u] - submit[u] for u in first}
         return ttft, sheds, aborted, wall, stalls
 
+    from apex_tpu.observability import percentile
+
     def pct(xs, q):
-        return float(np.percentile(xs, q)) if xs else 0.0
+        return percentile(xs, q) if xs else 0.0
 
     victims = victim_trace()
 
@@ -1886,6 +1893,96 @@ def bench_train_step(fast=False):
     }
 
 
+def bench_obs_pipeline(fast=False):
+    """Observability pipeline certification (docs/observability.md):
+    drive a small engine with the full observer attached (tracer +
+    flight recorder + metrics), write the dump, and run
+    tools/trace_summary.py over it end to end — so the post-mortem
+    tooling a dead round depends on is proven by every smoke run, not
+    first exercised at the incident. Also re-certifies the
+    zero-perturbation contract on this workload: the observed engine's
+    outputs must be bit-identical to an unobserved twin's. Value =
+    requests summarized; the section FAILS if the dump does not
+    round-trip, the summary misses a request, or bit-identity breaks."""
+    import importlib.util
+    import os as _os
+    import tempfile
+
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import Observability
+    from apex_tpu.serving import (EngineConfig, InferenceEngine, Request,
+                                  SamplingParams)
+
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.RandomState(_SALT + 7)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))
+    # a pool tight enough to preempt, so the trace exercises the
+    # requeue/resume path too
+    ekw = dict(max_batch=3, block_size=8, num_blocks=6,
+               max_prefill_len=8, max_seq_len=32, seed=3)
+    n_req = 3 if fast else 5
+    reqs = [Request(uid=f"o{i}",
+                    prompt=list(rng.randint(0, cfg.vocab_size, 6 + i)),
+                    max_new_tokens=12,
+                    sampling=(SamplingParams(temperature=1.0, top_k=16)
+                              if i % 2 else SamplingParams()))
+            for i in range(n_req)]
+
+    def serve(obs):
+        # request objects are reusable across engines: add_request
+        # starts a fresh lifecycle (resets the engine-owned status)
+        eng = InferenceEngine(model, params, EngineConfig(**ekw),
+                              obs=obs)
+        for r in reqs:
+            eng.add_request(r)
+        return eng.run(return_status=True)
+
+    t0 = time.perf_counter()
+    plain = serve(None)
+    obs = Observability()
+    observed = serve(obs)
+    identical = ({u: (tuple(r.tokens), r.status)
+                  for u, r in plain.items()}
+                 == {u: (tuple(r.tokens), r.status)
+                     for u, r in observed.items()})
+    if not identical:
+        raise AssertionError(
+            "observability perturbed engine output (tracing on != off)")
+
+    with tempfile.TemporaryDirectory() as td:
+        dump_path = obs.dump_to(_os.path.join(td, "dump.json"))
+        spec = importlib.util.spec_from_file_location(
+            "_trace_summary",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          "tools", "trace_summary.py"))
+        ts = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ts)
+        report = ts.summarize_file(dump_path)
+    dt = time.perf_counter() - t0
+    missing = [r.uid for r in reqs if f"{r.uid}:" not in report]
+    if missing:
+        raise AssertionError(
+            f"trace summary missed requests {missing}:\n{report}")
+    deep = obs.deep_stats()
+    print("# obs pipeline: " + report.splitlines()[1]
+          + f" | bit-identical {identical}", file=sys.stderr)
+    return {
+        "metric": "obs_pipeline_smoke_requests_summarized",
+        "value": n_req,
+        "unit": "requests",
+        "vs_baseline": 1.0,
+        "bit_identical_with_observer": bool(identical),
+        "trace_events": int(deep["trace_events"]),
+        "recorder_events": int(deep["recorder_events"]),
+        "ttft_observed": int(deep["metrics"]["serving_ttft_s"]["count"]),
+        "summary_lines": len(report.splitlines()),
+        "wall_s": round(dt, 3),
+    }
+
+
 def main():
     on_tpu = _backend_with_cpu_fallback() == "tpu"
     if "--smoke" in sys.argv:
@@ -1909,6 +2006,7 @@ def main():
             ("bench_serving_multitenant",
              lambda: bench_serving_multitenant(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
+            ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
             if not _run_section(name, fn, retries=0):
                 failed.append(name)
@@ -1971,7 +2069,8 @@ def main():
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
-                 bench_serving_multitenant, bench_train_step]
+                 bench_serving_multitenant, bench_train_step,
+                 bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

@@ -1,7 +1,11 @@
 """Doc-drift lint as a tier-1 test: every ``EngineConfig`` /
 ``TenantQuota`` field and every top-level ``stats()`` key must be
-named in docs/serving.md or docs/robustness.md — the next knob or
-counter cannot land undocumented (tools/check_docs.py)."""
+named in docs/serving.md or docs/robustness.md, and every trace event
+type, flight-recorder event kind, and exported metric name must be
+named in docs/observability.md — the next knob, counter, event, or
+metric cannot land undocumented (tools/check_docs.py). Each surface
+has a phantom-name self-test so the checker cannot rot into a
+tautology."""
 
 import importlib.util
 from pathlib import Path
@@ -35,3 +39,35 @@ def test_lint_actually_detects_drift(monkeypatch, tmp_path):
     monkeypatch.setattr(mod, "collect_names", with_phantom)
     missing = mod.main()
     assert ("stats() key", "phantom_counter_xyz") in missing
+
+
+def test_lint_detects_phantom_observability_names(monkeypatch):
+    """The observability surfaces are checked against
+    docs/observability.md specifically: a phantom metric, trace event
+    type, or recorder kind must each be flagged."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+    phantoms = [("metric", "serving_phantom_metric_s"),
+                ("trace event type", "phantom_event"),
+                ("recorder event kind", "phantom_kind")]
+
+    def with_phantoms():
+        return orig() + phantoms
+
+    monkeypatch.setattr(mod, "collect_names", with_phantoms)
+    missing = mod.main()
+    for p in phantoms:
+        assert p in missing
+
+
+def test_observability_names_are_checked_against_their_doc():
+    """A name present only in serving.md must NOT satisfy an
+    observability-kind check (and vice versa the real names pass):
+    the kinds map to their own doc files."""
+    mod = _load_check_docs()
+    # "spec_tokens" appears in serving.md but not observability.md —
+    # as a metric name it must read as missing
+    serving_text = mod._docs_text(mod.SERVING_DOCS)
+    obs_text = mod._docs_text(mod.OBS_DOCS)
+    assert "spec_tokens" in serving_text
+    assert "spec_tokens" not in obs_text

@@ -642,7 +642,7 @@ def _record_admissions(engine):
 
     def wrapped(entry):
         order.append(entry.request.uid)
-        orig(entry)
+        return orig(entry)   # pass the (wait_s, admit_t) pair through
 
     engine._note_admitted_wait = wrapped
     return order

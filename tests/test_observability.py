@@ -1,0 +1,569 @@
+"""The observability layer (ISSUE 11, docs/observability.md): the
+metrics registry / flight recorder / request tracer units, the shared
+percentile helper's pinned interpolation, trace-export validity, the
+offline summarizer, and — the acceptance bar — the zero-perturbation
+certification: engine outputs with tracing/recorder/metrics attached
+are bit-identical to without, across greedy/sampled x speculative/not
+x preemption x snapshot/restore."""
+
+import importlib.util
+import json
+import math
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import profiler
+from apex_tpu.models import GPTConfig, GPTLMHeadModel
+from apex_tpu.observability import (
+    RECORDER_EVENT_KINDS,
+    TRACE_EVENT_TYPES,
+    FlightRecorder,
+    Histogram,
+    MetricsRegistry,
+    Observability,
+    RequestTracer,
+    flatten_stats,
+    log_buckets,
+    percentile,
+)
+from apex_tpu.serving import (
+    EngineConfig,
+    EngineStalledError,
+    InferenceEngine,
+    Request,
+    SamplingParams,
+)
+from apex_tpu.train.loop import TrainLoop, WatchdogConfig
+from apex_tpu.utils.faults import FaultPlan, FaultSpec, SimulatedCrash
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+# a pool tight enough to force preemption under 3 concurrent
+# generations (the test_serving tight-pool recipe)
+TIGHT_KW = dict(max_batch=3, block_size=8, num_blocks=6,
+                max_prefill_len=8, max_seq_len=32, seed=7)
+
+
+def _mk(tiny_gpt, obs=None, clock=None, **overrides):
+    model, params = tiny_gpt
+    kw = dict(TIGHT_KW)
+    kw.update(overrides)
+    return InferenceEngine(model, params, EngineConfig(**kw),
+                           obs=obs, clock=clock)
+
+
+def _reqs(n=3, new=14, sampled=False, seed=11):
+    rng = np.random.RandomState(seed)
+    sp = (SamplingParams(temperature=1.0, top_k=20) if sampled
+          else SamplingParams())
+    return [Request(uid=f"r{i}", prompt=list(rng.randint(0, 128, 6 + i)),
+                    max_new_tokens=new, sampling=sp) for i in range(n)]
+
+
+def _results_key(res):
+    return {u: (tuple(r.tokens), r.status) for u, r in res.items()}
+
+
+# ---------------------------------------------------------------------------
+# the shared percentile helper (satellite: pinned interpolation)
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_pins_numpy_linear_interpolation():
+    cases = [[3.0], [1.0, 2.0], [5, 1, 9, 2], [7, 3, 3, 1, 8],
+             list(range(100, 0, -1))]
+    for xs in cases:
+        for q in (0, 10, 25, 50, 75, 90, 99, 100):
+            assert percentile(xs, q) == pytest.approx(
+                float(np.percentile(xs, q)), abs=1e-12), (xs, q)
+    # the even-n median the old StepTimer got wrong: ts[n // 2] of
+    # [1, 2, 3, 4] is 3.0; the median is 2.5
+    assert percentile([1.0, 2.0, 3.0, 4.0], 50) == 2.5
+    with pytest.raises(ValueError):
+        percentile([], 50)
+    with pytest.raises(ValueError):
+        percentile([1.0], 101)
+
+
+def test_step_timer_uses_interpolated_percentiles():
+    t = profiler.StepTimer(warmup=0)
+    t._times = [0.001, 0.002, 0.003, 0.004]   # even n
+    s = t.summary()
+    assert s["p50_ms"] == pytest.approx(2.5)
+    assert s["p90_ms"] == pytest.approx(
+        1e3 * float(np.percentile(t._times, 90)))
+    assert s["p99_ms"] == pytest.approx(
+        1e3 * float(np.percentile(t._times, 99)))
+    assert s["steps"] == 4 and s["min_ms"] <= s["p50_ms"] <= s["max_ms"]
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_log_buckets_geometry():
+    b = log_buckets(1e-3, 1.0, 4)
+    assert b[0] == pytest.approx(1e-3) and b[-1] == pytest.approx(1.0)
+    ratios = [b[i + 1] / b[i] for i in range(3)]
+    assert all(r == pytest.approx(ratios[0]) for r in ratios)
+    with pytest.raises(ValueError):
+        log_buckets(1.0, 0.5, 4)
+
+
+def test_histogram_observe_quantile_exposition():
+    h = Histogram("h_s", "help", buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == pytest.approx(5.0605)
+    assert h.counts == [1, 2, 1, 0, 1]       # last is the +Inf bucket
+    lines = h.expose()
+    assert 'h_s_bucket{le="0.001"} 1' in lines
+    assert 'h_s_bucket{le="0.01"} 3' in lines     # cumulative
+    assert 'h_s_bucket{le="+Inf"} 5' in lines
+    assert "h_s_count 5" in lines
+    # quantile estimate lands inside the right bucket
+    assert 0.001 <= h.quantile(50) <= 0.01 + 1e-12
+    assert Histogram("e", "").quantile(99) == 0.0
+
+
+def test_registry_exposition_and_get_or_create():
+    r = MetricsRegistry()
+    c = r.counter("a_total", "things")
+    c.inc()
+    c.inc(2)
+    assert r.counter("a_total") is c          # get-or-create
+    g = r.gauge("g")
+    g.set(1.5)
+    with pytest.raises(ValueError):
+        r.gauge("a_total")                    # kind clash
+    with pytest.raises(ValueError):
+        c.inc(-1)                             # counters only go up
+    text = r.exposition()
+    assert "# TYPE a_total counter" in text
+    assert "a_total 3" in text
+    assert "g 1.5" in text
+    assert r.as_dict()["a_total"] == 3
+
+
+def test_flatten_stats_is_the_sanctioned_flattener():
+    nested = {"a": 1, "b": {"x": 2.0, "y": {"z": "s"}}, "tenants": {"t": 1}}
+    flat = flatten_stats(nested)
+    assert flat == {"a": 1, "b.x": 2.0, "b.y.z": "s", "tenants.t": 1}
+    assert flatten_stats(nested, exclude=("tenants",)) == {
+        "a": 1, "b.x": 2.0, "b.y.z": "s"}
+
+
+def test_engine_stats_type_honesty_and_flattening(tiny_gpt):
+    """Satellite: stats() is annotated Dict[str, object] because it
+    really does nest (the per-tenant ledger); the sanctioned flattener
+    turns it scalar."""
+    engine = _mk(tiny_gpt)
+    stats = engine.stats()
+    assert isinstance(stats["tenants"], dict)        # the nested section
+    flat = flatten_stats(stats, exclude=("tenants",))
+    assert all(not isinstance(v, dict) for v in flat.values())
+    assert "tenants" not in " ".join(flat)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + tracer units
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_bound_dropped_and_incidents():
+    now = [0.0]
+    rec = FlightRecorder(capacity=4, clock=lambda: now[0])
+    with pytest.raises(ValueError):
+        rec.record("not_a_kind")
+    for i in range(10):
+        now[0] = float(i)
+        rec.record("tick", tick=i)
+    assert len(rec) == 4 and rec.dropped == 6
+    tail = rec.tail(2)
+    assert [e["tick"] for e in tail] == [8, 9]
+    assert [e["seq"] for e in rec.tail()] == [6, 7, 8, 9]
+    inc = rec.incident("quarantine", uid="x")
+    assert inc["label"] == "quarantine" and len(inc["events"]) == 4
+    assert len(rec.incidents) == 1
+    d = rec.dump()
+    json.loads(json.dumps(d))
+    assert d["dropped"] == 6 and len(d["incidents"]) == 1
+
+
+def test_tracer_rejects_unknown_types_and_caps():
+    tr = RequestTracer(clock=lambda: 0.0, max_events=2)
+    with pytest.raises(ValueError):
+        tr.event("not_a_type", "u")
+    tr.event("enqueue", "u")
+    tr.event("admit", "u", lane=0)
+    tr.event("terminal", "u", lane=0, status="finished")   # over cap
+    assert len(tr) == 2 and tr.dropped == 1
+    assert [e["type"] for e in tr.request_timeline("u")] == [
+        "enqueue", "admit"]
+
+
+def test_vocabularies_are_closed_and_exported():
+    assert "decode" in TRACE_EVENT_TYPES
+    assert "device_reset" in RECORDER_EVENT_KINDS
+    assert len(set(TRACE_EVENT_TYPES)) == len(TRACE_EVENT_TYPES)
+    assert len(set(RECORDER_EVENT_KINDS)) == len(RECORDER_EVENT_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# trace export validity (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _fake_clock():
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    return now, clock
+
+
+def _drive_with_obs(tiny_gpt, **over):
+    now, clock = _fake_clock()
+    obs = Observability(clock=clock)
+    engine = _mk(tiny_gpt, obs=obs, clock=clock, **over)
+    for r in _reqs():
+        engine.add_request(r)
+    while engine.has_work:
+        engine.step()
+        now[0] += 0.125           # deterministic trace timestamps
+    out, _ = engine.finished, engine.statuses
+    res = engine.run(return_status=True)
+    return obs, engine, res
+
+
+def test_chrome_trace_roundtrips_and_is_monotone_per_tid(tiny_gpt):
+    obs, engine, res = _drive_with_obs(tiny_gpt)
+    assert engine.stats()["num_preemptions"] >= 1   # the tight pool bit
+    ct = json.loads(json.dumps(obs.tracer.chrome_trace()))
+    evs = ct["traceEvents"]
+    assert any(e["ph"] == "M" and e["args"].get("name") == "engine"
+               for e in evs)
+    phs = {e["ph"] for e in evs}
+    assert {"B", "E", "X", "i", "M"} <= phs
+    last = {}
+    for e in evs:
+        if e["ph"] == "M":
+            continue
+        assert e["ts"] >= last.get(e["tid"], -1.0), e
+        last[e["tid"]] = e["ts"]
+        assert e["pid"] == 1
+    # under the injectable clock the timestamps are exact multiples of
+    # the fake tick (deterministic traces)
+    for e in evs:
+        if e["ph"] != "M":
+            assert (e["ts"] / 1e6 * 8) == pytest.approx(
+                round(e["ts"] / 1e6 * 8), abs=1e-6)
+
+
+def test_preempted_timeline_contiguous_and_complete(tiny_gpt):
+    obs, engine, res = _drive_with_obs(tiny_gpt)
+    tls = obs.tracer.timelines()
+    preempted = [uid for uid, tl in tls.items()
+                 if any(e["type"] == "preempt" for e in tl)]
+    assert preempted, "tight pool should have preempted someone"
+    for uid, tl in tls.items():
+        types = [e["type"] for e in tl]
+        assert types[0] == "enqueue"
+        assert types[-1] == "terminal"
+        assert tl[-1]["status"] == "finished"
+        # every preempt is immediately followed by its requeue, and a
+        # later re-admission continues the SAME timeline (contiguity)
+        for i, e in enumerate(tl):
+            if e["type"] == "preempt":
+                assert types[i + 1] == "requeue"
+                assert "admit" in types[i + 2:], "no re-admission traced"
+        # completeness: the prefill's first token + every drained
+        # decode token accounts for exactly the delivered output
+        drained = sum(e["tokens"] for e in tl if e["type"] == "drain")
+        assert drained + 1 == len(res[uid].tokens), uid
+        # timestamps never go backwards along the timeline
+        ts = [e["t"] for e in tl]
+        assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# the zero-perturbation certification (acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+@pytest.mark.parametrize("spec", [0, 2], ids=["plain", "speculative"])
+def test_observed_engine_is_bit_identical(tiny_gpt, sampled, spec):
+    """Tracing/recorder/metrics ON vs OFF, greedy + sampled,
+    speculative + not, on a pool tight enough to preempt: outputs and
+    statuses must match bit for bit."""
+    reqs = _reqs(sampled=sampled)
+
+    def serve(obs):
+        engine = _mk(tiny_gpt, obs=obs, spec_tokens=spec)
+        for r in reqs:
+            engine.add_request(r)
+        res = engine.run(return_status=True)
+        return res, engine.stats()
+
+    ref, ref_stats = serve(None)
+    obs = Observability()
+    got, got_stats = serve(obs)
+    assert _results_key(got) == _results_key(ref)
+    assert got_stats["num_preemptions"] == ref_stats["num_preemptions"]
+    assert got_stats["num_preemptions"] >= 1
+    # and the observer really observed
+    m = obs.metrics.as_dict()
+    assert m["serving_requests_total"] == len(reqs)
+    assert m["serving_tokens_total"] == sum(
+        len(r.tokens) for r in got.values())
+    assert m["serving_ttft_s"]["count"] == len(reqs)
+    assert m["serving_itl_s"]["count"] > 0
+    assert len(obs.recorder) > 0 and len(obs.tracer) > 0
+
+
+def test_snapshot_restore_cross_obs_bit_identical(tiny_gpt):
+    """Snapshot taken WITH an observer restores bit-identically into an
+    engine WITHOUT one (and vice versa): observer state is audit-only,
+    outside the fingerprint, never reloaded."""
+    reqs = _reqs(new=10, sampled=True, seed=23)
+
+    def uninterrupted():
+        engine = _mk(tiny_gpt)
+        for r in reqs:
+            engine.add_request(r)
+        return engine.run(return_status=True)
+
+    ref = uninterrupted()
+
+    def interrupted(obs_first, obs_second):
+        e1 = _mk(tiny_gpt, obs=obs_first)
+        for r in reqs:
+            e1.add_request(r)
+        for _ in range(3):
+            e1.step()
+        snap = json.loads(json.dumps(e1.snapshot()))
+        if obs_first is not None:
+            assert snap["observability"]["audit_only"] is True
+            assert isinstance(snap["observability"]["recorder_tail"],
+                              list)
+        else:
+            assert "observability" not in snap
+        partial = e1.run(return_status=True)
+        e2 = _mk(tiny_gpt, obs=obs_second)
+        e2.restore(snap)
+        rest = e2.run(return_status=True)
+        # the snapshot boundary: everything terminal before it drains
+        # from e1, the rest from e2 — the union must equal the
+        # uninterrupted run
+        merged = dict(rest)
+        for uid, r in partial.items():
+            if uid not in merged or snap["statuses"].get(uid):
+                merged[uid] = r
+        return {u: merged[u] for u in ref}
+
+    with_obs = interrupted(Observability(), None)
+    assert _results_key(with_obs) == _results_key(ref)
+    obs2 = Observability()
+    into_obs = interrupted(None, obs2)
+    assert _results_key(into_obs) == _results_key(ref)
+    # the restoring observer recorded the restore event
+    assert any(e["kind"] == "restore" for e in obs2.recorder.tail())
+
+
+# ---------------------------------------------------------------------------
+# incident paths: quarantine tails, stall, crash dump
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_freezes_recorder_incident(tiny_gpt):
+    plan = FaultPlan([FaultSpec(site="decode", kind="transient",
+                                every=1)])
+    obs = Observability()
+    model, params = tiny_gpt
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(max_batch=2, block_size=8, num_blocks=16,
+                     max_prefill_len=8, max_seq_len=32,
+                     max_dispatch_retries=1),
+        faults=plan, obs=obs)
+    for r in _reqs(n=2, new=4):
+        engine.add_request(r)
+    res = engine.run(return_status=True)
+    assert all(r.status == "failed" for r in res.values())
+    incidents = [i for i in obs.recorder.incidents
+                 if i["label"] == "quarantine"]
+    assert incidents and incidents[0]["events"], \
+        "quarantine must freeze a recorder tail"
+    kinds = {e["kind"] for e in obs.recorder.tail()}
+    assert "fault_retry" in kinds and "quarantine" in kinds
+    # the trace shows the terminal failure too
+    for uid in res:
+        tl = obs.tracer.request_timeline(uid)
+        assert tl[-1] == {**tl[-1], "type": "terminal",
+                          "status": "failed"}
+
+
+def test_engine_stalled_error_carries_recorder_tail():
+    err = EngineStalledError("m", {"k": 1},
+                             recorder_tail=[{"kind": "tick"}])
+    assert err.recorder_tail == [{"kind": "tick"}]
+    assert err.engine_stats == {"k": 1}
+    assert EngineStalledError("m", {}).recorder_tail is None
+
+
+def test_unhandled_run_exception_writes_crash_dump(tiny_gpt, tmp_path):
+    dump_path = tmp_path / "crash.json"
+    plan = FaultPlan([FaultSpec(site="decode", kind="crash", at=(1,))])
+    obs = Observability(crash_dump_path=str(dump_path))
+    model, params = tiny_gpt
+    engine = InferenceEngine(
+        model, params, EngineConfig(**TIGHT_KW), faults=plan, obs=obs)
+    for r in _reqs(n=2, new=6):
+        engine.add_request(r)
+    with pytest.raises(SimulatedCrash):
+        engine.run()
+    assert dump_path.exists()
+    dump = json.loads(dump_path.read_text())
+    assert dump["format"] == "apex_tpu-obs-dump-v1"
+    assert "SimulatedCrash" in dump["error"]
+    assert any(i["label"] == "crash"
+               for i in dump["recorder"]["incidents"])
+    # and the offline summarizer reads the crash dump directly
+    ts = _load_trace_summary()
+    report = ts.summarize_file(str(dump_path))
+    assert "CRASH DUMP" in report
+
+
+# ---------------------------------------------------------------------------
+# the offline summarizer (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _load_trace_summary():
+    path = (Path(__file__).resolve().parents[1] / "tools"
+            / "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("_trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_summary_reports_lifecycle_and_tallies(tiny_gpt, tmp_path):
+    obs, engine, res = _drive_with_obs(tiny_gpt)
+    p = tmp_path / "dump.json"
+    obs.dump_to(str(p))
+    ts = _load_trace_summary()
+    report = ts.summarize_file(str(p))
+    for uid in res:
+        assert f"{uid}: finished" in report
+    assert "preemptions" in report
+    assert "-- shed tally: none" in report
+    assert "-- ladder timeline: no transitions" in report
+    assert "serving_ttft_s p50=" in report
+    assert ts.main([str(p)]) == 0
+
+
+def test_trace_summary_counts_sheds(tiny_gpt):
+    now, clock = _fake_clock()
+    obs = Observability(clock=clock)
+    engine = _mk(tiny_gpt, obs=obs, clock=clock, max_waiting=1)
+    reqs = _reqs(n=3, new=4)
+    engine.add_request(reqs[0])
+    assert not engine.try_add(reqs[1])        # queue_full door shed
+    assert not engine.try_add(reqs[2])
+    engine.run()
+    ts = _load_trace_summary()
+    report = ts.summarize(obs.dump())
+    assert "queue_full=2" in report
+    assert obs.metrics.as_dict()["serving_sheds_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# stats(deep=True) + TrainLoop observability
+# ---------------------------------------------------------------------------
+
+
+def test_stats_deep_merges_observability(tiny_gpt):
+    obs = Observability()
+    engine = _mk(tiny_gpt, obs=obs)
+    for r in _reqs(n=2, new=4):
+        engine.add_request(r)
+    engine.run()
+    shallow = engine.stats()
+    assert "observability" not in shallow
+    deep = engine.stats(deep=True)
+    o = deep["observability"]
+    assert o["metrics"]["serving_requests_total"] == 2
+    assert o["trace_events"] > 0 and o["recorder_events"] > 0
+    # exposition is scrapable text
+    text = obs.metrics.exposition()
+    assert "# TYPE serving_ttft_s histogram" in text
+    assert 'serving_ttft_s_bucket{le="+Inf"} 2' in text
+    # no observer -> deep adds nothing
+    assert "observability" not in _mk(tiny_gpt).stats(deep=True)
+
+
+class _FakeState:
+    step = 0
+
+
+def test_trainloop_observability_watchdog_and_metrics():
+    obs = Observability()
+    losses = iter([1.0, float("nan"), float("nan"), float("nan"), 1.0])
+
+    def fake_step(state, batch):
+        return state, {"loss": next(losses)}
+
+    loop = TrainLoop(fake_step, _FakeState(),
+                     watchdog=WatchdogConfig(skip_steps=2,
+                                             rescale_steps=0),
+                     obs=obs)
+    with pytest.raises(Exception) as ei:
+        loop.run(range(5))
+    assert "non-finite" in str(ei.value)
+    m = obs.metrics.as_dict()
+    # all 5 dispatches count (the halt raises at the 5th step's
+    # deferred fetch of step 4's nan — after the dispatch was timed)
+    assert m["train_steps_total"] == 5
+    assert m["train_nonfinite_total"] == 3
+    assert m["train_step_s"]["count"] == 5
+    actions = [e["action"] for e in obs.recorder.tail()
+               if e["kind"] == "watchdog"]
+    assert actions == ["skip", "skip", "halt"]
+    assert any(i["label"] == "watchdog_halt"
+               for i in obs.recorder.incidents)
+    deep = loop.stats(deep=True)
+    assert deep["observability"]["metrics"]["train_steps_total"] == 5
+    assert "observability" not in loop.stats()
+
+
+def test_trainloop_without_obs_unchanged():
+    losses = iter(float(i) for i in range(6))
+
+    def fake_step(state, batch):
+        return state, {"loss": next(losses)}
+
+    loop = TrainLoop(fake_step, _FakeState())
+    out = loop.run(range(3))
+    assert [m["loss"] for m in out] == [0.0, 1.0, 2.0]
+    assert loop.stats()["steps_dispatched"] == 3

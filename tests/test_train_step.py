@@ -544,6 +544,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_overload_goodput_tokens_per_sec",
         "serving_tiny_multitenant_victim_goodput_tok_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
+        "obs_pipeline_smoke_requests_summarized",
     }
     for r in records:
         if "metric" in r:
@@ -583,6 +584,14 @@ def test_bench_smoke_mode_every_section_rc0():
         assert mt["per_tenant"][t]["throttled"] == 0, mt
         assert mt["per_tenant"][t]["goodput_tokens"] > 0, mt
     assert math.isfinite(mt["vs_baseline"]), mt
+    # the observability pipeline arm (docs/observability.md) certifies
+    # dump -> trace_summary end to end AND re-checks zero perturbation
+    ob = [r for r in records
+          if r.get("metric") == "obs_pipeline_smoke_requests_summarized"][0]
+    assert ob["bit_identical_with_observer"] is True, ob
+    assert ob["trace_events"] > 0 and ob["recorder_events"] > 0, ob
+    assert ob["ttft_observed"] == ob["value"], ob
+    assert ob["summary_lines"] > 0, ob
     # every section also leaves a wall-time/exit-status record, so a
     # section that dies is a visible "failed" entry in the artifact,
     # never just an absence
@@ -592,6 +601,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_train_step",
+        "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

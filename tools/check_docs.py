@@ -1,11 +1,15 @@
-"""Doc-drift lint: the serving surface must stay documented.
+"""Doc-drift lint: the serving + observability surfaces must stay
+documented.
 
 Asserts that every :class:`~apex_tpu.serving.EngineConfig` field, every
 :class:`~apex_tpu.serving.TenantQuota` field, and every top-level
 ``stats()`` counter key of a live engine is NAMED somewhere in
-``docs/serving.md`` or ``docs/robustness.md`` — so the next knob or
-counter cannot land undocumented. Wired in as a tier-1 test
-(tests/test_docs_lint.py); also runnable standalone::
+``docs/serving.md`` or ``docs/robustness.md`` — and that every trace
+event type, flight-recorder event kind, and exported metric name of
+the observability layer is named in ``docs/observability.md`` — so the
+next knob, counter, event, or metric cannot land undocumented. Wired
+in as a tier-1 test (tests/test_docs_lint.py, including a phantom-name
+self-test per surface); also runnable standalone::
 
     JAX_PLATFORMS=cpu python tools/check_docs.py   # exit 1 on drift
 
@@ -20,12 +24,16 @@ import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DOC_FILES = ("docs/serving.md", "docs/robustness.md")
+SERVING_DOCS = ("docs/serving.md", "docs/robustness.md")
+OBS_DOCS = ("docs/observability.md",)
+# kinds whose names belong in docs/observability.md; everything else
+# is the serving surface
+OBS_KINDS = ("trace event type", "recorder event kind", "metric")
 
 
-def _docs_text() -> str:
+def _docs_text(files) -> str:
     parts = []
-    for rel in DOC_FILES:
+    for rel in files:
         with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
             parts.append(f.read())
     return "\n".join(parts)
@@ -34,12 +42,23 @@ def _docs_text() -> str:
 def collect_names():
     """(kind, name) pairs the docs must mention. Building the stats
     surface needs a live engine: a tiny CPU model, never dispatched —
-    ``stats()`` is readable from construction."""
+    ``stats()`` is readable from construction. The observability
+    names come from the layer's own closed vocabularies (the trace/
+    recorder modules reject kinds outside them, so the lint and the
+    runtime can't drift apart) and a registry with both metric sets
+    registered."""
     sys.path.insert(0, REPO_ROOT)
     import jax
     import jax.numpy as jnp
 
     from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import (
+        RECORDER_EVENT_KINDS,
+        TRACE_EVENT_TYPES,
+        MetricsRegistry,
+        register_engine_metrics,
+        register_train_metrics,
+    )
     from apex_tpu.serving import (EngineConfig, InferenceEngine,
                                   TenantQuota)
 
@@ -54,16 +73,26 @@ def collect_names():
         max_batch=2, block_size=4, num_blocks=16, max_prefill_len=8,
         max_seq_len=16))
     names += [("stats() key", k) for k in engine.stats()]
+    names += [("trace event type", t) for t in TRACE_EVENT_TYPES]
+    names += [("recorder event kind", k) for k in RECORDER_EVENT_KINDS]
+    registry = MetricsRegistry()
+    register_engine_metrics(registry)
+    register_train_metrics(registry)
+    names += [("metric", n) for n in registry.names()]
     return names
 
 
 def main():
-    text = _docs_text()
-    missing = [(kind, name) for kind, name in collect_names()
-               if name not in text]
-    for kind, name in missing:
-        print(f"UNDOCUMENTED {kind}: {name!r} appears in neither "
-              f"{' nor '.join(DOC_FILES)}", file=sys.stderr)
+    serving_text = _docs_text(SERVING_DOCS)
+    obs_text = _docs_text(OBS_DOCS)
+    missing = []
+    for kind, name in collect_names():
+        text, where = ((obs_text, OBS_DOCS) if kind in OBS_KINDS
+                       else (serving_text, SERVING_DOCS))
+        if name not in text:
+            missing.append((kind, name))
+            print(f"UNDOCUMENTED {kind}: {name!r} appears in neither "
+                  f"{' nor '.join(where)}", file=sys.stderr)
     return missing
 
 
