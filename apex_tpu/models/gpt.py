@@ -182,7 +182,7 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
     batch. The mode is static (S is a trace constant), so an engine
     compiles exactly one program per shape — see docs/serving.md.
     """
-    from apex_tpu.serving.kv_cache import KVCache, paged_write
+    from apex_tpu.serving.kv_cache import write_kv
 
     B, S, h = q.shape
     nh = cfg.num_heads
@@ -195,26 +195,33 @@ def _cached_attention(cfg, q, k, v, kv_cache, layer, block_tables,
     valid = cache_positions < seq_lens[:, None]
     if write_start is not None:
         valid = valid & (cache_positions >= write_start[:, None])
-    kv_cache = KVCache(
-        k=paged_write(kv_cache.k, layer, block_tables, cache_positions,
-                      kh, valid),
-        v=paged_write(kv_cache.v, layer, block_tables, cache_positions,
-                      vh, valid),
-    )
+    # write_kv quantizes on the way in when the pool stores quantized
+    # blocks (per-row scales scattered through the same coordinates,
+    # docs/serving.md memory tiers); a full-precision pool takes
+    # exactly the pre-quantization paged_write path, bit for bit
+    kv_cache = write_kv(kv_cache, layer, block_tables, cache_positions,
+                        kh, vh, valid)
+    k_scales = (None if kv_cache.k_scale is None
+                else kv_cache.k_scale[layer])
+    v_scales = (None if kv_cache.v_scale is None
+                else kv_cache.v_scale[layer])
 
     if S == 1:
         from apex_tpu.ops.flash_attention import paged_decode_attention
 
         ctx = paged_decode_attention(qh[:, 0], kv_cache.k[layer],
                                      kv_cache.v[layer], block_tables,
-                                     seq_lens, scale)
+                                     seq_lens, scale,
+                                     k_scales=k_scales,
+                                     v_scales=v_scales)
         return ctx.reshape(B, 1, h), kv_cache
 
     from apex_tpu.ops.flash_attention import paged_prefill_attention
 
     ctx = paged_prefill_attention(qh, kv_cache.k[layer],
                                   kv_cache.v[layer], block_tables,
-                                  cache_positions, seq_lens, scale)
+                                  cache_positions, seq_lens, scale,
+                                  k_scales=k_scales, v_scales=v_scales)
     return ctx.reshape(B, S, h), kv_cache
 
 

@@ -38,6 +38,8 @@ RECORDER_EVENT_KINDS = (
     "alloc_pressure",       # CacheOutOfBlocks with no lane left to preempt
     "preempt",              # a lane preempted for pool pressure or quota
     "shed",                 # a request shed (queue_full/throttled/rejected)
+    "spill",                # an evicted prefix block copied to the host tier
+    "spill_upload",         # spilled blocks re-admitted by device upload
     "snapshot",             # snapshot() taken
     "restore",              # restore() applied
     "device_reset",         # drain-failure crash-restore (_reset_device_state)

@@ -1301,7 +1301,8 @@ _flash_bsh_core.defvjp(_bsh_vjp_fwd, _bsh_vjp_bwd)
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
-                           scale: float = 1.0):
+                           scale: float = 1.0, k_scales=None,
+                           v_scales=None, use_pallas=None):
     """Single-query attention against the paged KV pool.
 
     Args:
@@ -1316,6 +1317,12 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
       context_lens: ``[B]`` int32 — valid tokens per sequence INCLUDING
         the current one.
       scale: softmax temperature (typically ``1/sqrt(D)``).
+      k_scales, v_scales: ``[num_blocks, block_size, H]`` fp32 per-row
+        dequantization scales of a quantized pool (None = the pool is
+        full precision). Dequantization happens inside the read.
+      use_pallas: route the read chain through the fused Pallas kernel
+        (:mod:`apex_tpu.ops.paged_attention_pallas`); None consults the
+        ``APEX_PAGED_ATTENTION_PALLAS`` env flag.
 
     Returns ``[B, H, D]`` in ``q.dtype``.
     """
@@ -1330,11 +1337,14 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens,
     # per dispatch.
     return paged_prefill_attention(
         q[:, None], k_pages, v_pages, block_tables,
-        None, context_lens, scale)[:, 0]
+        None, context_lens, scale, k_scales=k_scales,
+        v_scales=v_scales, use_pallas=use_pallas)[:, 0]
 
 
 def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
-                            context_lens, scale: float = 1.0):
+                            context_lens, scale: float = 1.0,
+                            k_scales=None, v_scales=None,
+                            use_pallas=None):
     """Chunked-prefill attention: a fixed-size chunk of queries against
     the paged KV pool.
 
@@ -1373,14 +1383,45 @@ def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
       context_lens: ``[B]`` int32 — valid tokens in the cache INCLUDING
         this chunk's.
       scale: softmax temperature (typically ``1/sqrt(D)``).
+      k_scales, v_scales: ``[num_blocks, block_size, H]`` fp32 per-row
+        dequantization scales of a quantized pool (None = full
+        precision; the fp path is untouched when absent, bit for bit).
+        The scales gather through the SAME clipped table as the
+        payload and dequantize inside the read — quantized K/V never
+        materializes at full precision outside this chain.
+      use_pallas: run the gather→mask→softmax→weighted-sum chain as
+        ONE fused ``pallas_call``
+        (:mod:`apex_tpu.ops.paged_attention_pallas`) instead of the
+        composed XLA chain — READ side only (writes stay in XLA:
+        Pallas TPU has no scatter lowering, the BENCH_r01 lesson).
+        None consults the ``APEX_PAGED_ATTENTION_PALLAS`` env flag;
+        either way the kernel is taken only when its static shape
+        gate holds (interpret mode always qualifies), so the XLA
+        path below remains the universal fallback.
 
     Returns ``[B, C, H, D]`` in ``q.dtype``.
     """
     B, C, H, D = q.shape
     N = k_pages.shape[0]
+    from apex_tpu.ops.paged_attention_pallas import (
+        pallas_paged_read_wanted, pallas_paged_read_supported,
+        paged_read_attention)
+
+    if (pallas_paged_read_wanted(use_pallas)
+            and pallas_paged_read_supported(k_pages,
+                                            block_tables.shape[1], C)
+            and not use_jnp_fallback(q, k_pages, v_pages)):
+        return paged_read_attention(
+            q, k_pages, v_pages, block_tables, q_positions,
+            context_lens, scale, k_scales=k_scales, v_scales=v_scales)
     tbl = jnp.minimum(block_tables, N - 1)
     k = k_pages[tbl].reshape(B, -1, H, D)        # [B, ctx_max, H, D]
     v = v_pages[tbl].reshape(B, -1, H, D)
+    if k_scales is not None:
+        k = k.astype(jnp.float32) \
+            * k_scales[tbl].reshape(B, -1, H)[..., None]
+        v = v.astype(jnp.float32) \
+            * v_scales[tbl].reshape(B, -1, H)[..., None]
     ctx_max = k.shape[1]
 
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),

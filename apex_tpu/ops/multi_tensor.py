@@ -52,18 +52,33 @@ def _f32(t: Array) -> Array:
 
 
 def stochastic_round(x: Array, dtype, key) -> Array:
-    """Stochastically round fp32 ``x`` to ``dtype`` (bf16): add uniform
-    bits below the target mantissa, truncate. E[round(x)] == x, which
+    """Stochastically round fp32 ``x`` to ``dtype``: add uniform noise
+    below the target precision, truncate. E[round(x)] == x, which
     keeps low-precision EMA state (optimizer moments) from stalling when
     per-step increments round-to-nearest to zero — the reason the
     bf16-moments optimizer tier exists. Non-finite values pass through
-    unperturbed. fp32 targets return a plain cast (no-op rounding)."""
+    unperturbed. fp32 targets return a plain cast (no-op rounding).
+
+    Integer targets (the quantized KV-cache path,
+    :mod:`apex_tpu.serving.kv_cache`): ``floor(x + U[0, 1))`` — the same
+    unbiased-truncation construction in value space instead of bit
+    space — clamped to the SYMMETRIC integer range (``[-127, 127]`` for
+    int8, so a dequantized magnitude never exceeds its scale's design
+    max). Non-finite values round to 0 (integers have no non-finite
+    encoding; the KV quantizer never feeds them)."""
     dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        lim = float(min(-(info.min + 1), info.max))
+        u = jax.random.uniform(key, x.shape, jnp.float32)
+        r = jnp.clip(jnp.floor(x.astype(jnp.float32) + u), -lim, lim)
+        return jnp.where(jnp.isfinite(x), r, 0.0).astype(dtype)
     if dtype == jnp.float32:
         return x.astype(dtype)
     if dtype != jnp.bfloat16:
         raise NotImplementedError(
-            f"stochastic_round supports bf16/f32 targets, got {dtype}")
+            f"stochastic_round supports bf16/f32/integer targets, "
+            f"got {dtype}")
     bits = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
     xi = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     trunc = jax.lax.bitcast_convert_type(

@@ -27,9 +27,11 @@ from apex_tpu.serving.engine import (  # noqa: F401
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     DEFAULT_TENANT,
+    KV_QUANT_MODES,
     BlockAllocator,
     CacheOutOfBlocks,
     DeviceMirror,
+    HostSpillStore,
     KVCache,
     blocks_needed,
     copy_block,
@@ -39,7 +41,10 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     gather_blocks,
     gather_kv,
     hash_block_tokens,
+    kv_block_bytes,
     paged_write,
+    quantize_kv_rows,
+    write_kv,
 )
 from apex_tpu.serving.sampling import (  # noqa: F401
     SamplingParams,

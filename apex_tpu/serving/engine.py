@@ -168,6 +168,19 @@ greedy/sampled x speculative/not x preemption x snapshot/restore).
 Observer state is excluded from the snapshot fingerprint; recorder and
 trace tails ride ``snapshot()`` only as an audit section ``restore()``
 never reloads.
+
+**Memory tiers** (docs/serving.md): KV memory bounds concurrent
+users, so the cache is tiered. ``kv_quantization`` stores int8/fp8
+block payloads with per-row scales (quantize inside the jitted write,
+dequantize inside the attention read; position-keyed stochastic
+rounding keeps every determinism contract, and a quantized block
+charges the tenant ledger its reduced byte footprint).
+``spill_max_bytes`` adds a bounded host-RAM spill tier: LRU-evicted
+and ladder-flushed prefix blocks copy to a host store keyed by their
+chain hash and re-admit by device upload instead of recompute —
+token-identical, audit-only in snapshots. The read chain itself can
+run as one fused Pallas kernel (``APEX_PAGED_ATTENTION_PALLAS=1``,
+read side only, fp path bit-identical to the XLA chain).
 """
 
 from __future__ import annotations
@@ -191,14 +204,17 @@ from apex_tpu.utils.faults import (
 
 from apex_tpu.serving.kv_cache import (
     DEFAULT_TENANT,
+    KV_QUANT_MODES,
     BlockAllocator,
     CacheOutOfBlocks,
     DeviceMirror,
+    HostSpillStore,
     KVCache,
     blocks_needed,
     copy_block,
     device_block_table,
     hash_block_tokens,
+    kv_block_bytes,
 )
 from apex_tpu.serving.drafter import NgramDrafter
 from apex_tpu.serving.sampling import (
@@ -386,6 +402,28 @@ class EngineConfig:
     # utilization accounting workloads may assert on.
     enable_prefix_caching: bool = False
     kv_dtype: Optional[object] = None   # None = follow the amp policy
+    # Quantized block storage (docs/serving.md memory tiers): "int8"
+    # (symmetric int8, stochastic-rounded) or "fp8" (float8_e4m3,
+    # where the backend has it) K/V payloads with per-row fp32 scales
+    # carried block-wise; dequantization happens inside the attention
+    # read. None (default) keeps full-precision storage — bit-identical
+    # to the pre-quantization engine. Quantized outputs are tolerance-
+    # certified against the fp path, not bit-equal to it; the
+    # quantized path is itself fully deterministic (position-keyed
+    # rounding), so preemption/resume/snapshot bit-identity holds
+    # WITHIN a storage mode. A quantized block charges the tenant
+    # ledger its reduced byte footprint (the allocator's block_weight).
+    kv_quantization: Optional[str] = None
+    # Host-RAM spill tier for the prefix cache (docs/serving.md):
+    # LRU-evicted and ladder-flushed prefix blocks are copied to a
+    # bounded host store (this many payload bytes) keyed by their
+    # chain hash, and a later prefix match re-admits them by device
+    # upload instead of recompute. Requires enable_prefix_caching
+    # (the tier is keyed by the prefix index's hashes). None = off.
+    # Operational, not identity: spill state is audit-only in
+    # snapshots and the knob stays out of the restore fingerprint —
+    # a re-admitted block is certified token-identical to recompute.
+    spill_max_bytes: Optional[int] = None
     # Donate the cache pool to the jitted steps so XLA updates it in
     # place instead of materializing a second pool + copy per step
     # (double peak HBM and a full-pool write otherwise). Default off:
@@ -495,6 +533,20 @@ class EngineConfig:
         if self.decode_steps < 1:
             raise ValueError(
                 f"decode_steps must be >= 1, got {self.decode_steps}")
+        if self.kv_quantization not in KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quantization must be one of {KV_QUANT_MODES}, "
+                f"got {self.kv_quantization!r}")
+        if self.spill_max_bytes is not None:
+            if self.spill_max_bytes < 1:
+                raise ValueError(
+                    f"spill_max_bytes must be >= 1 (or None for no "
+                    f"spill tier), got {self.spill_max_bytes}")
+            if not self.enable_prefix_caching:
+                raise ValueError(
+                    "spill_max_bytes requires enable_prefix_caching: "
+                    "the spill tier is keyed by the prefix index's "
+                    "hash chains, and nothing registers without it")
         if self.spec_tokens < 0:
             raise ValueError(
                 f"spec_tokens must be >= 0, got {self.spec_tokens}")
@@ -978,11 +1030,44 @@ class InferenceEngine:
                 f"max_position_embeddings ({cfg.max_position_embeddings})")
         self.max_blocks_per_seq = blocks_needed(config.max_seq_len,
                                                 config.block_size)
+        head_dim = cfg.hidden_size // cfg.num_heads
         self.cache = KVCache.create(
             cfg.num_layers, config.num_blocks, config.block_size,
-            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
-            dtype=config.kv_dtype)
-        self.allocator = BlockAllocator(config.num_blocks)
+            cfg.num_heads, head_dim, dtype=config.kv_dtype,
+            quantization=config.kv_quantization)
+        # the tenant ledger's per-block charge unit: a quantized block
+        # charges its reduced byte footprint relative to the full-
+        # precision block this config would otherwise store, so
+        # max_resident_blocks quotas are denominated in full-precision
+        # block equivalents (1.0 — and the pre-quantization ledger,
+        # bit for bit — when quantization is off)
+        if config.kv_quantization is not None:
+            self._block_weight = (
+                kv_block_bytes(cfg.num_layers, config.block_size,
+                               cfg.num_heads, head_dim,
+                               quantization=config.kv_quantization)
+                / kv_block_bytes(cfg.num_layers, config.block_size,
+                                 cfg.num_heads, head_dim,
+                                 dtype=config.kv_dtype))
+        else:
+            self._block_weight = 1.0
+        self.allocator = BlockAllocator(config.num_blocks,
+                                        block_weight=self._block_weight)
+        # the host-RAM spill tier (docs/serving.md memory tiers):
+        # evicted/flushed prefix blocks copy to this bounded host
+        # store; _admit re-admits matches by device upload
+        self.spill: Optional[HostSpillStore] = None
+        self._spill_hits = 0
+        self._spill_misses = 0
+        if config.spill_max_bytes is not None:
+            self.spill = HostSpillStore(config.spill_max_bytes)
+            self.allocator.attach_spill(self.spill, self._spill_payload)
+            # the upload program: one jitted scatter of a host block
+            # into the pool (its own jit slot — the prefill/decode
+            # compile-count contract is untouched)
+            self._upload = jax.jit(
+                self._upload_impl,
+                donate_argnums=(0,) if config.donate_cache else ())
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
         self.waiting = _WaitingQueue(weights=config.tenant_weights,
                                      quantum=config.drr_quantum)
@@ -1372,13 +1457,16 @@ class InferenceEngine:
         if q is None:
             return None
         if q.max_resident_blocks is not None:
-            worst = blocks_needed(
+            # worst-case charge in block_weight units (quantized
+            # blocks charge their reduced footprint, so quantization
+            # admits requests a full-precision pool would refuse)
+            worst = self._block_weight * blocks_needed(
                 len(request.prompt) + request.max_new_tokens,
                 self.config.block_size)
-            if worst > q.max_resident_blocks:
-                return (f"needs up to {worst} blocks but is capped at "
-                        f"max_resident_blocks={q.max_resident_blocks} "
-                        f"(it could never run)")
+            if worst > q.max_resident_blocks + 1e-9:
+                return (f"needs up to {worst:g} block-units but is "
+                        f"capped at max_resident_blocks="
+                        f"{q.max_resident_blocks} (it could never run)")
         if (q.max_waiting is not None
                 and self.waiting.tenant_depth(request.tenant)
                 >= q.max_waiting):
@@ -1783,6 +1871,82 @@ class InferenceEngine:
                                            tenant=slot.request.tenant)
             slot.num_registered += 1
 
+    # -- the host-RAM spill tier (docs/serving.md memory tiers) ------------
+
+    def _spill_payload(self, block_id: int):
+        """The allocator's spill fetch: one block's device contents as
+        host numpy arrays (scales included for quantized pools), or
+        None when the device read fails — the spill is an
+        optimization, so a transient fetch error (e.g. a poisoned
+        in-flight dispatch surfacing at this sync) just skips it; the
+        eviction proceeds as a plain discard and the next prefix miss
+        recomputes. Never called from ``_reset_device_state``'s
+        allocator reset (reset clears without evicting), so a known-
+        poisoned pool is never captured into the host tier."""
+        try:
+            payload = {"k": np.asarray(self.cache.k[:, block_id]),
+                       "v": np.asarray(self.cache.v[:, block_id])}
+            if self.cache.k_scale is not None:
+                payload["k_scale"] = np.asarray(
+                    self.cache.k_scale[:, block_id])
+                payload["v_scale"] = np.asarray(
+                    self.cache.v_scale[:, block_id])
+        except SimulatedCrash:
+            raise
+        except Exception:
+            return None
+        if self._obs is not None:
+            self._obs.record(
+                "spill", block=int(block_id),
+                bytes=int(sum(a.nbytes for a in payload.values())))
+        return payload
+
+    def _upload_args(self, up_blocks, payloads):
+        """Fixed-shape inputs for the ONE upload dispatch an admission
+        pays regardless of how many blocks it re-admits: ids padded to
+        ``[max_blocks_per_seq]`` with the out-of-bounds id (the
+        scatter's ``mode="drop"`` discards padding rows), payloads
+        zero-padded to match — one compiled program, one full-pool
+        functional update per admission instead of one per block."""
+        M = self.max_blocks_per_seq
+        ids = np.full(M, self.config.num_blocks, np.int32)
+        ids[:len(up_blocks)] = up_blocks
+
+        def stack(key):
+            proto = payloads[0][key]
+            buf = np.zeros((M,) + proto.shape, proto.dtype)
+            for i, p in enumerate(payloads):
+                buf[i] = p[key]
+            return jnp.asarray(buf)
+
+        args = [jnp.asarray(ids), stack("k"), stack("v")]
+        if self.cache.k_scale is not None:
+            args += [stack("k_scale"), stack("v_scale")]
+        return args
+
+    def _upload_impl(self, cache, ids, k_blk, v_blk, *scales):
+        """An admission's spilled blocks re-admitted in ONE scatter:
+        ``ids`` is ``[max_blocks_per_seq]`` int32 (out-of-bounds
+        padding dropped), payloads ``[M, L, bs, H, D]`` (+ scales for
+        quantized pools) — the device half of a spill hit. The
+        uploaded bytes are exactly the bytes each block held when it
+        was spilled, so a re-admitted prefix attends bit-identically
+        to the never-evicted one (and, on the fp path, to recompute)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        out = KVCache(
+            k=cache.k.at[:, ids].set(jnp.moveaxis(k_blk, 0, 1),
+                                     mode="drop"),
+            v=cache.v.at[:, ids].set(jnp.moveaxis(v_blk, 0, 1),
+                                     mode="drop"))
+        if scales:
+            ks, vs = scales
+            out = out._replace(
+                k_scale=cache.k_scale.at[:, ids].set(
+                    jnp.moveaxis(ks, 0, 1), mode="drop"),
+                v_scale=cache.v_scale.at[:, ids].set(
+                    jnp.moveaxis(vs, 0, 1), mode="drop"))
+        return out
+
     # -- admission (optimistic: current need, not worst case) --------------
 
     def _admission_priority_limit(self) -> Optional[int]:
@@ -1922,15 +2086,29 @@ class InferenceEngine:
                         entry.hashes = self._seq_hashes(seq)
                     hashes = entry.hashes
                     matched = self.allocator.lookup_prefix(hashes)
-                m_tok = len(matched) * bs
+                # the spill tier extends the device match: the run of
+                # chain hashes CONTINUING the device prefix that the
+                # host store still holds re-admits by upload instead
+                # of recompute (chain order matters — a spilled block
+                # past a gap is unreachable, exactly like the device
+                # index)
+                spill_run: List[str] = []
+                if self.spill is not None:
+                    j = len(matched)
+                    while j < len(hashes) and hashes[j] in self.spill:
+                        spill_run.append(hashes[j])
+                        j += 1
+                n_up = len(spill_run)
+                m_tok = (len(matched) + n_up) * bs
                 if self._shed_if_infeasible(entry, L - m_tok, below, skip):
                     continue    # gate shed the head; try the next one
-                tail = blocks_needed(L, bs) - len(matched)
+                tail = blocks_needed(L, bs) - len(matched) - n_up
                 # current need = blocks through the FIRST decode write
                 # (position L): blocks_needed(L + 1). That is tail + 1
                 # only when the prompt exactly fills its blocks — an
                 # exact-fit request whose whole generation lives in the
-                # last partial block needs no headroom at all
+                # last partial block needs no headroom at all.
+                # Upload blocks are fresh allocations, so they count.
                 need = blocks_needed(L + 1, bs) - len(matched)
                 # per-tenant block quota: would this admission push the
                 # tenant's fractional resident charge over its cap?
@@ -1939,9 +2117,11 @@ class InferenceEngine:
                 tenant = entry.request.tenant
                 q = self._tenant_quota(tenant)
                 if q is not None and q.max_resident_blocks is not None:
-                    extra = need + sum(
+                    # charges are in block_weight units (quantized
+                    # blocks charge their reduced footprint)
+                    extra = self._block_weight * (need + sum(
                         1.0 / (self.allocator.refcount(b) + 1)
-                        for b in matched)
+                        for b in matched))
                     if (self.allocator.tenant_charge(tenant) + extra
                             > q.max_resident_blocks + 1e-9):
                         if not self._tenant_has_resident(tenant):
@@ -1980,9 +2160,43 @@ class InferenceEngine:
                     self._obs.note_admit(entry.request.uid, idx, wait_s,
                                          cached_blocks=len(matched),
                                          t=admit_t)
-                blocks = matched + (self.allocator.alloc(tail,
-                                                         tenant=tenant)
-                                    if tail else [])
+                # spill hits re-admit by upload: fresh device blocks,
+                # the host payloads scattered in by ONE fixed-shape
+                # dispatch, the chain hashes registered — the slot
+                # owns them exactly like matched blocks, and the
+                # positions they cover never re-prefill. Payloads are
+                # popped BEFORE the alloc: alloc may itself evict
+                # cached blocks INTO the spill store, and the store's
+                # byte-bound LRU could then drop exactly the entries
+                # this admission probed (the probe does not refresh
+                # recency) — popping first makes that race impossible.
+                up_blocks: List[int] = []
+                if spill_run:
+                    payloads = [self.spill.pop(h) for h in spill_run]
+                    up_blocks = self.allocator.alloc(n_up, tenant=tenant)
+                    self.cache = self._upload(
+                        self.cache,
+                        *self._upload_args(up_blocks, payloads))
+                    for h, nb in zip(spill_run, up_blocks):
+                        self.allocator.register_prefix(h, nb,
+                                                       tenant=tenant)
+                    self._spill_hits += n_up
+                    if self._obs is not None:
+                        self._obs.record("spill_upload",
+                                         uid=entry.request.uid,
+                                         blocks=n_up)
+                if self.spill is not None:
+                    # per-BLOCK misses, the same unit as the hits (one
+                    # per re-admitted block), so spill_hit_rate is the
+                    # fraction of spill-eligible blocks the tier
+                    # served; counted only at a committed admission
+                    # (not per blocked-head re-peek, which would
+                    # inflate the denominator)
+                    self._spill_misses += (len(hashes) - len(matched)
+                                           - n_up)
+                blocks = matched + up_blocks \
+                    + (self.allocator.alloc(tail, tenant=tenant)
+                       if tail else [])
                 self._prefix_lookup_blocks += len(hashes)
                 self._prefix_hit_blocks += len(matched)
                 self._prompt_blocks_allocated += tail
@@ -1991,8 +2205,8 @@ class InferenceEngine:
                              tokens=seq, prefill_len=L, prefill_pos=m_tok,
                              context_len=m_tok, blocks=blocks,
                              block_hashes=list(hashes),
-                             num_registered=len(matched), generated=[],
-                             last_token=0, started=False)
+                             num_registered=len(matched) + n_up,
+                             generated=[], last_token=0, started=False)
                 if entry.generated and m_tok == L:
                     # resumed and fully cached: nothing to recompute
                     slot.generated = list(entry.generated)
@@ -2310,7 +2524,8 @@ class InferenceEngine:
                     if (q is not None
                             and q.max_resident_blocks is not None
                             and self.allocator.tenant_charge(tenant)
-                            + grow > q.max_resident_blocks + 1e-9
+                            + grow * self._block_weight
+                            > q.max_resident_blocks + 1e-9
                             and self._preempt_tenant_lane(tenant, i)):
                         # over quota: the tenant paid with its own
                         # youngest lane — re-check (the freed charge
@@ -2879,6 +3094,14 @@ class InferenceEngine:
         d["kv_dtype"] = (None if self.config.kv_dtype is None
                          else str(jnp.dtype(self.config.kv_dtype)))
         for knob in ("max_dispatch_retries", "retry_backoff_s",
+                     # the spill tier is operational capacity tuning:
+                     # a re-admitted block is certified token-identical
+                     # to recompute, so restoring into a replica with a
+                     # different (or no) spill bound changes nothing
+                     # the fingerprint protects. kv_quantization STAYS
+                     # in the fingerprint: quantized outputs are not
+                     # the fp outputs — storage mode IS identity.
+                     "spill_max_bytes",
                      "max_waiting", "queue_high_watermark",
                      "free_block_low_watermark", "degrade_patience",
                      "degrade_admit_priority",
@@ -3013,6 +3236,15 @@ class InferenceEngine:
                 for _, i in live},
             "allocator": self.allocator.snapshot_state(),
         }
+        if self.spill is not None:
+            # AUDIT-ONLY, like the allocator section: spilled K/V
+            # bytes do not ride a JSON snapshot and restore() never
+            # reads this — a restored engine starts with an empty
+            # spill tier and re-warms it (hits are an optimization,
+            # never identity; the fingerprint excludes the knob)
+            snap["spill"] = dict(self.spill.stats(), audit_only=True,
+                                 hits=int(self._spill_hits),
+                                 misses=int(self._spill_misses))
         if self._obs is not None:
             # AUDIT-ONLY, like the block tables: the flight-recorder
             # tail and trace depth ride along for post-mortems, and
@@ -3043,10 +3275,14 @@ class InferenceEngine:
         if snap.get("version") != 1:
             raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
         mine, theirs = self._config_fingerprint(), dict(snap["config"])
-        if mine != theirs:
-            diff = {k: (theirs.get(k), mine.get(k))
-                    for k in set(mine) | set(theirs)
-                    if mine.get(k) != theirs.get(k)}
+        # compare by .get() so a knob ADDED since the snapshot was
+        # taken (absent key) equals its None default — an older
+        # snapshot restores into an engine that leaves the new knob
+        # off, which is exactly the config it ran under
+        diff = {k: (theirs.get(k), mine.get(k))
+                for k in set(mine) | set(theirs)
+                if mine.get(k) != theirs.get(k)}
+        if diff:
             raise ValueError(
                 f"snapshot config mismatch (snapshot vs engine): {diff}")
         if self.has_work or self._arrival_count or self.finished:
@@ -3221,6 +3457,25 @@ class InferenceEngine:
             "prefix_cache_hit_rate": (self._prefix_hit_blocks / lookups
                                       if lookups else 0.0),
             "prompt_blocks_allocated": self._prompt_blocks_allocated,
+            # the host-RAM spill tier (docs/serving.md memory tiers):
+            # current residency, lifetime traffic, and the re-admit
+            # hit rate — all zero with the tier off
+            # `is not None`, not truthiness: the store defines __len__
+            # and an empty (fully re-admitted) store is falsy
+            "spill_blocks": (len(self.spill) if self.spill is not None
+                             else 0),
+            "spill_bytes": (self.spill.total_bytes
+                            if self.spill is not None else 0),
+            "num_blocks_spilled": (self.spill.puts
+                                   if self.spill is not None else 0),
+            "num_spill_evictions": (self.spill.evictions
+                                    if self.spill is not None else 0),
+            "spill_hits": self._spill_hits,
+            "spill_misses": self._spill_misses,
+            "spill_hit_rate": (
+                self._spill_hits
+                / (self._spill_hits + self._spill_misses)
+                if self._spill_hits + self._spill_misses else 0.0),
             # robustness counters (docs/robustness.md): every failure
             # path feeds one, so chaos runs are assertable from stats()
             "num_timeouts": self._num_timeouts,

@@ -41,6 +41,31 @@ returns the active ``amp.initialize`` handle's compute dtype (bf16 for
 O1-O3, fp32 for O0) unless overridden — the cache is activation-class
 state, so it follows the activation precision, not the master-weight
 precision.
+
+**Quantized block storage** (``KVCache.create(quantization="int8")``,
+docs/serving.md memory tiers): the K/V payload pools store int8 (or
+fp8 where the backend supports it) with fp32 scales carried alongside
+the pool, organized per block — ``k_scale``/``v_scale`` are ``[L, N,
+bs, H]``, one scale per written (token, head) row, scattered/copied/
+permuted with exactly the block ops that move the payload (so CoW,
+defrag, and spill move a block's scales with its bytes). The quantize
+path reuses :func:`apex_tpu.ops.multi_tensor.stochastic_round` keyed
+by the token's ABSOLUTE cache position, so a given K/V row always
+rounds the same way regardless of lane placement, ``decode_steps``,
+or preemption/resume — quantized runs keep the engine's determinism
+contract. Dequantization happens inside the attention read
+(:func:`apex_tpu.ops.flash_attention.paged_prefill_attention`). With
+``quantization=None`` the scale fields are ``None`` and every code
+path is the pre-quantization one, bit for bit.
+
+**Host-RAM spill tier** (:class:`HostSpillStore`, docs/serving.md):
+instead of discarding an LRU-evicted or ladder-flushed prefix block,
+the allocator (when a store is attached) copies its contents to a
+bounded host-side LRU keyed by the block's SHA-256 chain hash; a later
+prefix match re-admits it by device upload instead of recompute. The
+store holds only blocks NOT currently device-indexed (re-admission
+pops; re-registration discards) — the invariant
+:meth:`BlockAllocator.check_integrity` enforces.
 """
 
 from __future__ import annotations
@@ -75,18 +100,91 @@ def default_kv_dtype(dtype=None):
     return jnp.dtype(jnp.float32)
 
 
+# the storage modes KVCache.create accepts (docs/serving.md memory
+# tiers): None = full-precision (the amp-policy dtype), "int8" =
+# symmetric int8 with per-row fp32 scales, "fp8" = float8_e4m3 with
+# per-row fp32 scales (backends without an fp8 dtype raise at create)
+KV_QUANT_MODES = (None, "int8", "fp8")
+
+# base key of the quantizer's stochastic rounding, folded with each
+# token's ABSOLUTE cache position — a module constant (not the engine
+# seed) so the same K/V values at the same position always round
+# identically across engines, restores, and re-prefills (the resume-
+# determinism contract extended to the quantized path)
+_KV_QUANT_SEED = 0x51CA17
+
+
+def fp8_kv_dtype():
+    """The fp8 storage dtype, or None when this jax has no fp8."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def _quant_storage_dtype(quantization):
+    if quantization == "int8":
+        return jnp.dtype(jnp.int8)
+    if quantization == "fp8":
+        dt = fp8_kv_dtype()
+        if dt is None:
+            raise NotImplementedError(
+                "kv quantization 'fp8' requires a jax with "
+                "jnp.float8_e4m3fn; use 'int8' on this backend")
+        return jnp.dtype(dt)
+    raise ValueError(
+        f"unknown kv quantization {quantization!r} "
+        f"(expected one of {KV_QUANT_MODES})")
+
+
+def _quant_value_max(quantization) -> float:
+    """The quantizer's design max: scales are ``amax / qmax`` so the
+    largest row magnitude maps onto the representable extreme."""
+    if quantization == "int8":
+        return 127.0
+    return float(jnp.finfo(fp8_kv_dtype()).max)
+
+
+def kv_block_bytes(num_layers: int, block_size: int, num_heads: int,
+                   head_dim: int, dtype=None, quantization=None) -> int:
+    """Device bytes one block costs across every layer — K + V payload
+    plus (when quantized) the per-row fp32 scales. The number behind
+    the bench's byte-budget pool sizing and the tenant ledger's
+    reduced-footprint charge for quantized blocks."""
+    if quantization is None:
+        item = default_kv_dtype(dtype).itemsize
+        return 2 * num_layers * block_size * num_heads * head_dim * item
+    item = _quant_storage_dtype(quantization).itemsize
+    payload = 2 * num_layers * block_size * num_heads * head_dim * item
+    scales = 2 * num_layers * block_size * num_heads * 4
+    return payload + scales
+
+
 class KVCache(NamedTuple):
-    """The device-side block pools (a pytree of two arrays).
+    """The device-side block pools (a pytree of two payload arrays,
+    plus two scale arrays when quantized).
 
     ``k`` / ``v``: ``[num_layers, num_blocks, block_size, num_heads,
     head_dim]``. The pool is allocated once at engine start and updated
     functionally (scatter in, new pytree out); the layout keeps the
     ``(num_heads * head_dim)`` product in the trailing dims so a block
     row is lane-tileable on TPU.
+
+    ``k_scale`` / ``v_scale`` (quantized storage only, else ``None``):
+    ``[num_layers, num_blocks, block_size, num_heads]`` fp32 — one
+    dequantization scale per written (token, head) row, organized per
+    block so every op that moves a block (scatter, CoW copy, defrag
+    permutation, host spill) moves its scales by the same indices.
     """
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantization(self) -> Optional[str]:
+        """The storage mode this pool was created with (from dtype)."""
+        if self.k_scale is None:
+            return None
+        return "int8" if self.k.dtype == jnp.int8 else "fp8"
 
     @property
     def num_layers(self) -> int:
@@ -110,10 +208,17 @@ class KVCache(NamedTuple):
 
     @classmethod
     def create(cls, num_layers: int, num_blocks: int, block_size: int,
-               num_heads: int, head_dim: int, dtype=None) -> "KVCache":
-        dt = default_kv_dtype(dtype)
+               num_heads: int, head_dim: int, dtype=None,
+               quantization: Optional[str] = None) -> "KVCache":
         shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
-        return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+        if quantization is None:
+            dt = default_kv_dtype(dtype)
+            return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+        dt = _quant_storage_dtype(quantization)
+        sshape = shape[:-1]
+        return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   k_scale=jnp.zeros(sshape, jnp.float32),
+                   v_scale=jnp.zeros(sshape, jnp.float32))
 
 
 class CacheOutOfBlocks(RuntimeError):
@@ -162,8 +267,24 @@ class BlockAllocator:
       ``match_prefix`` revives them.
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, block_weight: float = 1.0):
         self.num_blocks = int(num_blocks)
+        # the per-block charge unit of the tenant ledger: quantized
+        # pools pass their reduced byte footprint relative to the
+        # full-precision block (e.g. ~0.28 for int8-vs-fp32), so a
+        # tenant's fractional resident charge — and therefore its
+        # max_resident_blocks quota — is denominated in FULL-PRECISION
+        # block equivalents and quantization genuinely buys headroom.
+        # 1.0 (the default, and every unquantized engine) keeps the
+        # ledger bit-identical to the pre-quantization allocator.
+        if not block_weight > 0:
+            raise ValueError(
+                f"block_weight must be > 0, got {block_weight}")
+        self.block_weight = float(block_weight)
+        # the host-RAM spill tier (attach_spill): evicted/flushed
+        # prefix blocks copy to this store instead of vanishing
+        self.spill_store: Optional["HostSpillStore"] = None
+        self._spill_fetch = None
         # pop() from the end serves ascending ids first — keeps early
         # allocations compact, which makes defrag cheap in the common case
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
@@ -231,17 +352,20 @@ class BlockAllocator:
         total = self._ref.get(b, 0)
         if not total:
             return
+        w = self.block_weight
         for t, n in self._tenant_refs[b].items():
             self._tenant_charge_acc[t] = \
-                self._tenant_charge_acc.get(t, 0.0) + sign * n / total
+                self._tenant_charge_acc.get(t, 0.0) + sign * w * n / total
 
     def tenant_charge(self, tenant: str) -> float:
         """The tenant's fractional resident-block charge: each active
-        block contributes ``tenant_refs / total_refs`` — a private
-        block charges 1.0, a block shared evenly across two tenants
-        charges each 0.5. This is the number the engine's
-        ``max_resident_blocks`` quota is enforced against (sharing a
-        prefix makes a tenant CHEAPER, never more expensive). O(1):
+        block contributes ``block_weight * tenant_refs / total_refs``
+        — a private block charges ``block_weight`` (1.0 unquantized;
+        the reduced byte footprint for quantized pools), a block
+        shared evenly across two tenants charges each half that. This
+        is the number the engine's ``max_resident_blocks`` quota is
+        enforced against (sharing a prefix makes a tenant CHEAPER,
+        never more expensive — and so does quantization). O(1):
         maintained incrementally by the mutation paths."""
         return max(0.0, self._tenant_charge_acc.get(tenant, 0.0))
 
@@ -265,17 +389,42 @@ class BlockAllocator:
             "flushed_blocks": self._flushed_by_tenant.get(t, 0),
         } for t in sorted(tenants)}
 
+    # -- the host-RAM spill tier (docs/serving.md memory tiers) ------------
+
+    def attach_spill(self, store: "HostSpillStore", fetch) -> None:
+        """Wire the host spill tier in: every block
+        :meth:`_evict_one` drops (LRU pressure or a ladder flush) is
+        first copied to ``store`` under its chain hash, using
+        ``fetch(block_id) -> payload dict | None`` to read the device
+        contents (the engine owns the pool, so it owns the fetch — a
+        fetch returning None, e.g. on a transient device error, simply
+        skips the spill: the tier is an optimization, never a
+        correctness dependency). :meth:`register_prefix` discards the
+        stored copy for a hash the moment a device block is indexed
+        under it, keeping the store's contents disjoint from the
+        device index (the :meth:`check_integrity` invariant)."""
+        self.spill_store = store
+        self._spill_fetch = fetch
+
     # -- alloc / free / share ----------------------------------------------
 
     def _evict_one(self, flushed: bool = False) -> int:
         """Drop the least-recently-used cached block (unregister it),
         charging the eviction to the tenant that registered the block
         (``flushed`` routes the charge to the flush counter — the
-        degradation ladder's rung-2 accounting)."""
+        degradation ladder's rung-2 accounting). With a spill tier
+        attached, the block's contents are copied to the host store
+        first — the eviction stops being a future recompute and
+        becomes a future upload."""
         b, _ = self._evictable.popitem(last=False)
         h = self._block_to_hash.pop(b)
         del self._hash_to_block[h]
         owner = self._cached_owner.pop(b, None)
+        if self.spill_store is not None and self._spill_fetch is not None:
+            payload = self._spill_fetch(b)
+            if payload is not None:
+                self.spill_store.put(h, payload,
+                                     tenant=owner or DEFAULT_TENANT)
         if owner is not None:
             counter = (self._flushed_by_tenant if flushed
                        else self._evicted_by_tenant)
@@ -377,6 +526,12 @@ class BlockAllocator:
         self._hash_to_block[block_hash] = block_id
         self._block_to_hash[block_id] = block_hash
         self._cached_owner[block_id] = tenant
+        if self.spill_store is not None:
+            # a device block now serves this hash: the host copy is
+            # redundant (and would violate the disjointness invariant
+            # check_integrity enforces) — a fresh recompute registering
+            # the same content supersedes the spilled copy
+            self.spill_store.discard(block_hash)
         return True
 
     def lookup_prefix(self, hashes: Sequence[str]) -> List[int]:
@@ -544,6 +699,21 @@ class BlockAllocator:
             raise ValueError(
                 f"cached-owner entries for unregistered blocks: "
                 f"{sorted(stray_owner)}")
+        # the host spill tier must stay disjoint from the device index
+        # (a hash served by a resident block has no business holding a
+        # host copy — re-admission pops, re-registration discards) and
+        # within its configured byte bound
+        if self.spill_store is not None:
+            overlap = (set(self.spill_store.hashes())
+                       & set(self._hash_to_block))
+            if overlap:
+                raise ValueError(
+                    f"{len(overlap)} hash(es) both device-indexed and "
+                    f"spilled (e.g. {sorted(overlap)[:2]})")
+            if self.spill_store.total_bytes > self.spill_store.max_bytes:
+                raise ValueError(
+                    f"spill store holds {self.spill_store.total_bytes} "
+                    f"bytes, over its {self.spill_store.max_bytes} bound")
         # the incremental charge accumulator must track the exact
         # per-block sums (within float tolerance); verified then
         # REBASED to the exact values so drift never accumulates
@@ -551,7 +721,8 @@ class BlockAllocator:
         exact: Dict[str, float] = {}
         for b, refs in self._tenant_refs.items():
             for t, n in refs.items():
-                exact[t] = exact.get(t, 0.0) + n / self._ref[b]
+                exact[t] = exact.get(t, 0.0) \
+                    + self.block_weight * n / self._ref[b]
         for t in set(exact) | set(self._tenant_charge_acc):
             if abs(exact.get(t, 0.0)
                    - self._tenant_charge_acc.get(t, 0.0)) > 1e-6:
@@ -580,6 +751,99 @@ class BlockAllocator:
 
 def blocks_needed(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))
+
+
+class HostSpillStore:
+    """The host-RAM spill tier of the prefix cache (docs/serving.md
+    memory tiers): a bounded LRU of evicted prefix blocks, keyed by
+    the SHA-256 chain hash the device index uses — hashes are globally
+    comparable, so a spilled block is re-admittable by ANY engine with
+    the same model/config (the fleet-migration enabler ROADMAP item 2
+    names).
+
+    Each entry is one block's full device contents as host numpy
+    arrays: ``{"k": [L, bs, H, D], "v": [L, bs, H, D]}`` in the pool's
+    storage dtype, plus ``"k_scale"``/``"v_scale"`` (``[L, bs, H]``
+    fp32) for quantized pools — a spilled quantized block re-admits
+    bit-identically, scales included. ``max_bytes`` bounds the payload
+    total; inserts evict least-recently-used entries past it (and an
+    entry larger than the whole bound is dropped on arrival, counted
+    as an eviction).
+
+    The store is an OPTIMIZATION tier, never identity: entries are
+    audit-only in ``snapshot()`` (restore never reads them), a miss
+    just means recompute, and a hit is token-identical to recompute
+    (the re-admit equivalence cert in tests/test_kv_memory.py)."""
+
+    def __init__(self, max_bytes: int):
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        # hash -> {"payload": dict of np arrays, "tenant": str,
+        # "bytes": int}; insertion order = LRU order (puts re-insert)
+        self._entries: "OrderedDict[str, Dict[str, object]]" = \
+            OrderedDict()
+        self.total_bytes = 0
+        self.puts = 0          # lifetime blocks spilled in
+        self.evictions = 0     # entries dropped by the byte bound
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, block_hash: str) -> bool:
+        return block_hash in self._entries
+
+    def hashes(self):
+        return self._entries.keys()
+
+    def _drop(self, block_hash: str) -> None:
+        rec = self._entries.pop(block_hash)
+        self.total_bytes -= rec["bytes"]
+
+    def put(self, block_hash: str, payload: Dict[str, np.ndarray],
+            tenant: str = DEFAULT_TENANT) -> bool:
+        """Insert (or refresh) a block's contents at the MRU end,
+        evicting LRU entries past the byte bound. Returns whether the
+        entry is resident after the call."""
+        nbytes = sum(int(a.nbytes) for a in payload.values()
+                     if a is not None)
+        if block_hash in self._entries:
+            self._drop(block_hash)
+        self.puts += 1
+        if nbytes > self.max_bytes:
+            self.evictions += 1
+            return False
+        self._entries[block_hash] = {
+            "payload": payload, "tenant": tenant, "bytes": nbytes}
+        self.total_bytes += nbytes
+        while self.total_bytes > self.max_bytes:
+            _, rec = self._entries.popitem(last=False)
+            self.total_bytes -= rec["bytes"]
+            self.evictions += 1
+        return block_hash in self._entries
+
+    def pop(self, block_hash: str) -> Optional[Dict[str, np.ndarray]]:
+        """Remove and return a block's payload (None on miss) — the
+        re-admission read. Popping (rather than peeking) keeps the
+        store disjoint from the device index: the caller is about to
+        upload and register a device block under this hash."""
+        rec = self._entries.get(block_hash)
+        if rec is None:
+            return None
+        self._drop(block_hash)
+        return rec["payload"]
+
+    def discard(self, block_hash: str) -> None:
+        if block_hash in self._entries:
+            self._drop(block_hash)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "blocks": len(self._entries),
+            "bytes": int(self.total_bytes),
+            "puts": int(self.puts),
+            "evictions": int(self.evictions),
+        }
 
 
 class DeviceMirror:
@@ -631,6 +895,16 @@ def device_block_table(host_tables: np.ndarray, num_blocks: int) -> jax.Array:
     return jnp.asarray(np.where(t >= 0, t, num_blocks), jnp.int32)
 
 
+def _page_offsets(block_tables: jax.Array, positions: jax.Array,
+                  valid: jax.Array, N: int, bs: int):
+    """(page, off) scatter coordinates for per-token block writes;
+    invalid positions route to the out-of-bounds page ``N`` so the
+    caller's ``mode="drop"`` scatter discards them."""
+    page = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    page = jnp.where(valid, page, N)
+    return page, positions % bs
+
+
 def paged_write(pages: jax.Array, layer: int, block_tables: jax.Array,
                 positions: jax.Array, values: jax.Array,
                 valid: jax.Array) -> jax.Array:
@@ -649,11 +923,87 @@ def paged_write(pages: jax.Array, layer: int, block_tables: jax.Array,
         decode slots, already-cached prefix positions).
     """
     N, bs = pages.shape[1], pages.shape[2]
-    page = jnp.take_along_axis(block_tables, positions // bs, axis=1)
-    page = jnp.where(valid, page, N)
-    off = positions % bs
+    page, off = _page_offsets(block_tables, positions, valid, N, bs)
     return pages.at[layer, page, off].set(
         values.astype(pages.dtype), mode="drop")
+
+
+def quantize_kv_rows(values: jax.Array, positions: jax.Array,
+                     quantization: str, stream: int = 0):
+    """Quantize ``[B, S, H, D]`` K/V rows to the storage dtype.
+
+    Per (token, head) row: ``scale = max|row| / qmax`` (qmax = 127 for
+    int8, the fp8 finite max for fp8), payload = the scaled row rounded
+    into storage. int8 rounding is STOCHASTIC via
+    :func:`apex_tpu.ops.multi_tensor.stochastic_round`, keyed by
+    ``(stream, absolute position)`` (``positions``, ``[B, S]``) — a
+    pure function of (value, stream, position), so re-prefilling the
+    same token after preemption/restore reproduces the identical
+    quantized bytes and the engine's resume-determinism contract
+    survives quantization. ``stream`` decorrelates consumers sharing
+    positions: :func:`write_kv` tags each (layer, K-vs-V) pair with
+    its own stream, so a token's K and V rows — and its rows across
+    layers — draw INDEPENDENT rounding noise (correlated noise would
+    compound in one direction through the network instead of
+    averaging out; determinism only needs the stream to be a static
+    property of the call site, which (layer, k/v) is). fp8 rounds by
+    the cast (round-to-nearest; its mantissa keeps relative error, so
+    stochastic bits buy nothing).
+
+    Returns ``(payload [B, S, H, D] storage-dtype, scales [B, S, H]
+    fp32)``; an all-zero row stores payload 0 with scale 0 (dequant
+    reproduces the zeros exactly).
+    """
+    from apex_tpu.ops.multi_tensor import stochastic_round
+
+    dt = _quant_storage_dtype(quantization)
+    qmax = _quant_value_max(quantization)
+    v32 = values.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v32), axis=-1)              # [B, S, H]
+    scale = amax / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    x = v32 / safe[..., None]
+    if quantization == "fp8":
+        return x.astype(dt), scale
+    B, S = positions.shape
+    base = jax.random.fold_in(jax.random.PRNGKey(_KV_QUANT_SEED),
+                              int(stream))
+    keys = jax.vmap(lambda p: jax.random.fold_in(base, p))(
+        positions.reshape(-1))
+    q = jax.vmap(lambda row, key: stochastic_round(row, dt, key))(
+        x.reshape((B * S,) + x.shape[2:]), keys)
+    return q.reshape(x.shape), scale
+
+
+def write_kv(cache: KVCache, layer: int, block_tables: jax.Array,
+             positions: jax.Array, k_values: jax.Array,
+             v_values: jax.Array, valid: jax.Array) -> KVCache:
+    """Scatter one layer's K AND V rows into the pool, quantizing on
+    the way in when the pool stores quantized blocks (payload + scales
+    land through the same ``(page, off)`` coordinates, so a block's
+    scales always travel with its bytes). The full-precision path is
+    exactly two :func:`paged_write` calls — bit-identical to the
+    pre-quantization write."""
+    mode = cache.quantization
+    if mode is None:
+        return cache._replace(
+            k=paged_write(cache.k, layer, block_tables, positions,
+                          k_values, valid),
+            v=paged_write(cache.v, layer, block_tables, positions,
+                          v_values, valid))
+    N, bs = cache.k.shape[1], cache.k.shape[2]
+    page, off = _page_offsets(block_tables, positions, valid, N, bs)
+    # distinct rounding streams per (layer, K-vs-V): same positions,
+    # independent noise (see quantize_kv_rows)
+    qk, sk = quantize_kv_rows(k_values, positions, mode,
+                              stream=2 * layer)
+    qv, sv = quantize_kv_rows(v_values, positions, mode,
+                              stream=2 * layer + 1)
+    return KVCache(
+        k=cache.k.at[layer, page, off].set(qk, mode="drop"),
+        v=cache.v.at[layer, page, off].set(qv, mode="drop"),
+        k_scale=cache.k_scale.at[layer, page, off].set(sk, mode="drop"),
+        v_scale=cache.v_scale.at[layer, page, off].set(sv, mode="drop"))
 
 
 def gather_kv(pages: jax.Array, layer: int,
@@ -678,16 +1028,29 @@ def copy_block(cache: KVCache, src, dst) -> KVCache:
     int32 scalars so a single jitted program serves every copy."""
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
-    return KVCache(
+    out = KVCache(
         k=cache.k.at[:, dst].set(cache.k[:, src]),
         v=cache.v.at[:, dst].set(cache.v[:, src]),
     )
+    if cache.k_scale is not None:
+        # quantized pools: the copy must carry the source block's
+        # scales, or the CoW'd block would dequantize the right bytes
+        # with the wrong (stale/zero) scales — silently wrong K/V
+        out = out._replace(
+            k_scale=cache.k_scale.at[:, dst].set(cache.k_scale[:, src]),
+            v_scale=cache.v_scale.at[:, dst].set(cache.v_scale[:, src]))
+    return out
 
 
 def gather_blocks(cache: KVCache, perm: jax.Array) -> KVCache:
     """Apply a block permutation to the pool (``new[i] = old[perm[i]]``)
-    — the device half of :func:`defragment`."""
-    return KVCache(k=cache.k[:, perm], v=cache.v[:, perm])
+    — the device half of :func:`defragment`. Scale pools (quantized
+    storage) permute with their payload."""
+    out = KVCache(k=cache.k[:, perm], v=cache.v[:, perm])
+    if cache.k_scale is not None:
+        out = out._replace(k_scale=cache.k_scale[:, perm],
+                           v_scale=cache.v_scale[:, perm])
+    return out
 
 
 def defragment(cache: KVCache, allocator: BlockAllocator,

@@ -1732,6 +1732,181 @@ def bench_serving_multitenant(fast=False):
     }
 
 
+def bench_serving_kv_memory(fast=False):
+    """Memory scale-up arm (round 11, docs/serving.md memory tiers):
+    the capacity story of quantized KV blocks and the host-RAM spill
+    tier, measured where it matters — concurrent residents under a
+    FIXED device byte budget, and recompute avoided on a re-serve.
+
+    Phase 1 (capacity): the same seeded bursty trace served by two
+    engines whose pools hold the SAME number of KV bytes — one storing
+    full-precision (fp32) blocks, one int8-with-scales blocks (so the
+    int8 pool holds ~2.7x the block count). Reports peak concurrent
+    residents and decode tokens/s per arm and ASSERTS the int8 pool
+    sustains >= 1.5x the fp peak (the acceptance bar: quantization
+    must buy real concurrency, not just smaller numbers). Both arms
+    replay identical prompts/arrivals, and ``vs_baseline`` is the
+    residents ratio.
+
+    Phase 2 (spill): an int8 + prefix-caching engine with the host
+    spill tier serves distinct prompts, takes a full rung-2-style
+    flush (every evictable block spilled to host RAM), then RE-SERVES
+    the same prompts — prefix hits now re-admit by device upload
+    instead of recompute. Reports the spill hit rate (asserted
+    nonzero) and asserts the re-serve outputs are token-identical to
+    the first pass (greedy + deterministic engine: the upload path
+    must not perturb a single token). ``fast=True`` is the tier-1
+    smoke shape."""
+    import dataclasses as _dc
+
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, InferenceEngine,
+                                  Request, kv_block_bytes)
+
+    # FIXED seeds, not _SALT: this arm asserts (like the multitenant
+    # arm), so the workload must be the workload the asserts were
+    # designed against
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (1, 8))))
+    bs, hd = 8, cfg.hidden_size // cfg.num_heads
+    fp_block = kv_block_bytes(cfg.num_layers, bs, cfg.num_heads, hd,
+                              dtype=jnp.float32)
+    q_block = kv_block_bytes(cfg.num_layers, bs, cfg.num_heads, hd,
+                             quantization="int8")
+    fp_blocks = 10
+    budget = fp_blocks * fp_block
+    int8_blocks = budget // q_block
+    plen, new = 16, 16          # 32 tokens = 4 blocks per resident
+    ticks = 6 if fast else 12
+    base_rate = 1.5 if fast else 2.0
+
+    def capacity_arm(quant, num_blocks):
+        ecfg = EngineConfig(max_batch=8, block_size=bs,
+                            num_blocks=int(num_blocks),
+                            max_prefill_len=16, max_seq_len=32,
+                            decode_steps=4, kv_dtype=jnp.float32,
+                            kv_quantization=quant)
+        eng = InferenceEngine(model, params, ecfg)
+        eng.add_request(Request(uid="warm", prompt=[1] * plen,
+                                max_new_tokens=2))
+        eng.run()               # compile outside the clock
+        rr = np.random.RandomState(1)
+
+        def make(tick, k):
+            return Request(
+                uid=f"m{k}",
+                prompt=list(rr.randint(0, cfg.vocab_size, plen)),
+                max_new_tokens=new)
+
+        trace = _poisson_burst_trace(
+            np.random.RandomState(2), ticks=ticks,
+            base_rate=base_rate, make_request=make,
+            burst_start=ticks // 3, burst_end=2 * ticks // 3,
+            burst_factor=2)
+        s0 = eng.stats()
+        peak = 0
+        t0 = time.perf_counter()
+        ti = 0
+        for tick in range(ticks):
+            while ti < len(trace) and trace[ti][0] <= tick:
+                eng.add_request(trace[ti][1])
+                ti += 1
+            eng.step()
+            peak = max(peak, int(eng.stats()["active_slots"]))
+        while eng.has_work:
+            eng.step()
+            peak = max(peak, int(eng.stats()["active_slots"]))
+        dt = time.perf_counter() - t0
+        s1 = eng.stats()
+        toks = s1["num_tokens_decoded"] - s0["num_tokens_decoded"]
+        return {
+            "num_blocks": int(num_blocks),
+            "block_bytes": int(fp_block if quant is None else q_block),
+            "peak_residents": peak,
+            "decode_tokens_per_sec": round(toks / max(dt, 1e-9), 3),
+            "decode_tokens": int(toks),
+            "preemptions": int(s1["num_preemptions"]),
+            "wall_s": round(dt, 4),
+        }, len(trace)
+
+    fp_arm, offered = capacity_arm(None, fp_blocks)
+    int8_arm, _ = capacity_arm("int8", int8_blocks)
+    ratio = int8_arm["peak_residents"] / max(fp_arm["peak_residents"], 1)
+    assert ratio >= 1.5, (
+        f"int8 storage must sustain >= 1.5x the fp concurrent "
+        f"residents under an equal byte budget "
+        f"(got {int8_arm['peak_residents']} vs "
+        f"{fp_arm['peak_residents']})")
+    # both arms served the identical trace; token counts must agree
+    # (no EOS in play — a divergence means an arm silently dropped
+    # work, which would invalidate the tokens/s comparison)
+    assert int8_arm["decode_tokens"] == fp_arm["decode_tokens"], (
+        fp_arm, int8_arm)
+
+    # phase 2: spill tier hit rate on a re-serve pass
+    scfg = EngineConfig(max_batch=2, block_size=bs, num_blocks=8,
+                        max_prefill_len=16, max_seq_len=32,
+                        kv_dtype=jnp.float32, kv_quantization="int8",
+                        enable_prefix_caching=True,
+                        spill_max_bytes=64 * q_block)
+    eng = InferenceEngine(model, params, scfg)
+    rr = np.random.RandomState(3)
+    prompts = [list(rr.randint(0, cfg.vocab_size, plen))
+               for _ in range(3 if fast else 6)]
+
+    def serve(tag):
+        for i, p in enumerate(prompts):
+            eng.add_request(Request(uid=f"{tag}{i}", prompt=p,
+                                    max_new_tokens=4))
+        return eng.run()
+
+    first = serve("a")
+    eng.allocator.flush_evictable()   # the rung-2 flush: all -> spill
+    second = serve("b")
+    sstats = eng.stats()
+    eng.check_allocator_integrity()
+    reserve_identical = all(
+        second[f"b{i}"] == first[f"a{i}"]
+        for i in range(len(prompts)))
+    assert sstats["spill_hits"] > 0 and sstats["spill_hit_rate"] > 0, \
+        sstats
+    assert reserve_identical, "spill re-admit perturbed tokens"
+
+    print(f"# kv-memory: budget {budget} B -> fp {fp_blocks} blocks "
+          f"(peak {fp_arm['peak_residents']} residents, "
+          f"{fp_arm['decode_tokens_per_sec']:.1f} tok/s) vs int8 "
+          f"{int8_blocks} blocks (peak {int8_arm['peak_residents']}, "
+          f"{int8_arm['decode_tokens_per_sec']:.1f} tok/s) = "
+          f"{ratio:.2f}x residents | spill hit rate "
+          f"{sstats['spill_hit_rate']:.2f} "
+          f"({sstats['spill_hits']} uploads)", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
+        "value": int8_arm["decode_tokens_per_sec"],
+        "unit": "tokens/sec",
+        # the capacity headline: concurrent residents at int8 vs fp
+        # under the same byte budget
+        "vs_baseline": round(ratio, 3),
+        "residents_ratio": round(ratio, 3),
+        "byte_budget": int(budget),
+        "num_offered": int(offered),
+        "fp": fp_arm,
+        "int8": int8_arm,
+        "spill": {
+            "hits": int(sstats["spill_hits"]),
+            "misses": int(sstats["spill_misses"]),
+            "hit_rate": round(float(sstats["spill_hit_rate"]), 4),
+            "blocks_spilled": int(sstats["num_blocks_spilled"]),
+            "bytes": int(sstats["spill_bytes"]),
+            "reserve_token_identical": bool(reserve_identical),
+        },
+    }
+
+
 def bench_train_step(fast=False):
     """Fused train step (apex_tpu.train): the whole global optimizer
     step — amp O2 scaled forward/backward, ``accum_steps`` scanned
@@ -2005,6 +2180,8 @@ def main():
              lambda: bench_serving_overload(fast=True)),
             ("bench_serving_multitenant",
              lambda: bench_serving_multitenant(fast=True)),
+            ("bench_serving_kv_memory",
+             lambda: bench_serving_kv_memory(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -2069,8 +2246,8 @@ def main():
     secondary = [bench_layer_norm, bench_fused_lamb, bench_ddp_scaling,
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
-                 bench_serving_multitenant, bench_train_step,
-                 bench_obs_pipeline]
+                 bench_serving_multitenant, bench_serving_kv_memory,
+                 bench_train_step, bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

@@ -543,6 +543,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_speculative_decode_tokens_per_sec",
         "serving_tiny_overload_goodput_tokens_per_sec",
         "serving_tiny_multitenant_victim_goodput_tok_per_sec",
+        "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -584,6 +585,21 @@ def test_bench_smoke_mode_every_section_rc0():
         assert mt["per_tenant"][t]["throttled"] == 0, mt
         assert mt["per_tenant"][t]["goodput_tokens"] > 0, mt
     assert math.isfinite(mt["vs_baseline"]), mt
+    # the kv-memory arm (docs/serving.md memory tiers) must show
+    # quantization buying REAL concurrency under an equal byte budget
+    # and the spill tier actually re-admitting on the re-serve pass —
+    # a silently-skipped phase or a zero hit rate is a quiet capacity
+    # lie
+    km = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_kv_memory_int8_decode_tokens_per_sec"][0]
+    assert km["residents_ratio"] >= 1.5, km
+    assert km["int8"]["peak_residents"] > km["fp"]["peak_residents"], km
+    assert km["int8"]["num_blocks"] > km["fp"]["num_blocks"], km
+    assert km["spill"]["hit_rate"] > 0, km
+    assert km["spill"]["blocks_spilled"] > 0, km
+    assert km["spill"]["reserve_token_identical"] is True, km
+    assert math.isfinite(km["value"]) and km["value"] > 0, km
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -600,8 +616,8 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_layer_norm", "bench_fused_lamb", "bench_ddp_scaling",
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
-        "bench_serving_multitenant", "bench_train_step",
-        "bench_obs_pipeline",
+        "bench_serving_multitenant", "bench_serving_kv_memory",
+        "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

@@ -110,6 +110,14 @@ def summarize(dump: Dict) -> str:
     resets = [e for e in rec_events if e.get("kind") == "device_reset"]
     if resets:
         lines.append(f"-- device resets: {len(resets)}")
+    spills = [e for e in rec_events if e.get("kind") == "spill"]
+    uploads = [e for e in rec_events if e.get("kind") == "spill_upload"]
+    if spills or uploads:
+        lines.append(
+            f"-- spill tier: {len(spills)} blocks spilled "
+            f"({sum(int(e.get('bytes', 0)) for e in spills)} bytes), "
+            f"{sum(int(e.get('blocks', 0)) for e in uploads)} blocks "
+            f"re-admitted by upload across {len(uploads)} admissions")
     incidents = rec.get("incidents") or []
     for inc in incidents:
         lines.append(
