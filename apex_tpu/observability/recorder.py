@@ -40,8 +40,11 @@ RECORDER_EVENT_KINDS = (
     "shed",                 # a request shed (queue_full/throttled/rejected)
     "spill",                # an evicted prefix block copied to the host tier
     "spill_upload",         # spilled blocks re-admitted by device upload
-    "snapshot",             # snapshot() taken
+    "snapshot",             # snapshot() taken (lightweight=True: checkpoint())
     "restore",              # restore() applied
+    "replica_down",         # a fleet replica declared dead (or retired)
+    "failover",             # the dead replica's requests re-homed
+    "migrate",              # drain-and-migrate moved requests off a replica
     "device_reset",         # drain-failure crash-restore (_reset_device_state)
     "stall",                # EngineStalledError about to raise
     "watchdog",             # TrainLoop non-finite-loss watchdog action

@@ -1,9 +1,10 @@
 """apex_tpu.serving — the inference leg of the stack.
 
 Paged KV-cache (:mod:`~apex_tpu.serving.kv_cache`), continuous-batching
-prefill/decode engine (:mod:`~apex_tpu.serving.engine`), and jit-stable
-sampling (:mod:`~apex_tpu.serving.sampling`); design notes in
-docs/serving.md. The training-side capability surface (amp dtype
+prefill/decode engine (:mod:`~apex_tpu.serving.engine`), jit-stable
+sampling (:mod:`~apex_tpu.serving.sampling`), and the crash-tolerant
+multi-replica fleet router (:mod:`~apex_tpu.serving.fleet`); design
+notes in docs/serving.md and docs/fleet.md. The training-side capability surface (amp dtype
 policy, the flash-attention kernel family, the GPT/BERT models) is
 reused, not duplicated: the cache stores in the amp compute dtype, the
 decode path lives in :mod:`apex_tpu.ops.flash_attention`, and the model
@@ -25,6 +26,11 @@ from apex_tpu.serving.engine import (  # noqa: F401
     TenantQuota,
     TenantThrottledError,
 )
+from apex_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig,
+    FleetFailedError,
+    FleetRouter,
+)
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     DEFAULT_TENANT,
     KV_QUANT_MODES,
@@ -44,6 +50,7 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     kv_block_bytes,
     paged_write,
     quantize_kv_rows,
+    seq_block_hashes,
     write_kv,
 )
 from apex_tpu.serving.sampling import (  # noqa: F401

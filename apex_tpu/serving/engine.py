@@ -215,6 +215,7 @@ from apex_tpu.serving.kv_cache import (
     device_block_table,
     hash_block_tokens,
     kv_block_bytes,
+    seq_block_hashes,
 )
 from apex_tpu.serving.drafter import NgramDrafter
 from apex_tpu.serving.sampling import (
@@ -511,6 +512,19 @@ class EngineConfig:
     spec_adapt: bool = False
     spec_accept_low: float = 0.5
     spec_accept_high: float = 0.8
+    # -- fleet serving (docs/fleet.md) ---------------------------------
+    # Periodic lightweight checkpointing: every N scheduler ticks the
+    # engine refreshes ``last_checkpoint`` with :meth:`checkpoint` — a
+    # snapshot-format host picture taken WITHOUT draining the in-flight
+    # decode dispatch (no host sync, unlike snapshot()), so a fleet
+    # router holds a bounded-staleness failover picture at near-zero
+    # steady-state cost. Tokens emitted after the checkpoint are
+    # re-derived bit-identically on restore (resume determinism: the
+    # records carry prompt + generated-so-far + the arrival PRNG
+    # identity). None = off (the default; snapshot() is unchanged).
+    # Operational, not identity: excluded from the restore fingerprint
+    # like the retry/overload knobs.
+    snapshot_interval_ticks: Optional[int] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -606,6 +620,12 @@ class EngineConfig:
             raise ValueError(
                 f"tenant_rate_tau_s must be > 0, got "
                 f"{self.tenant_rate_tau_s}")
+        if (self.snapshot_interval_ticks is not None
+                and self.snapshot_interval_ticks < 1):
+            raise ValueError(
+                f"snapshot_interval_ticks must be >= 1 (or None for no "
+                f"periodic checkpointing), got "
+                f"{self.snapshot_interval_ticks}")
         if self.spec_adapt and self.spec_tokens < 1:
             raise ValueError(
                 "spec_adapt requires spec_tokens >= 1 (there is no "
@@ -1102,6 +1122,14 @@ class InferenceEngine:
         self._num_spec_blocks_rolled_back = 0
         self._num_snapshots = 0
         self._num_restores = 0
+        # -- fleet serving (docs/fleet.md) -----------------------------
+        # the bounded-staleness failover picture: refreshed every
+        # snapshot_interval_ticks by checkpoint(), read by the fleet
+        # router when this replica dies
+        self.last_checkpoint: Optional[Dict[str, object]] = None
+        self._num_checkpoints = 0
+        self._num_migrated_in = 0
+        self._num_migrated_out = 0
         # -- overload protection (docs/robustness.md) ------------------
         self._num_ticks = 0
         self._queue_depth_peak = 0
@@ -1846,12 +1874,7 @@ class InferenceEngine:
     # -- prefix caching ----------------------------------------------------
 
     def _seq_hashes(self, tokens: Sequence[int]) -> List[str]:
-        bs = self.config.block_size
-        hashes, prev = [], None
-        for j in range(len(tokens) // bs):
-            prev = hash_block_tokens(prev, tokens[j * bs: (j + 1) * bs])
-            hashes.append(prev)
-        return hashes
+        return seq_block_hashes(tokens, self.config.block_size)
 
     def _register_full_blocks(self, slot: _Slot) -> None:
         """Index every newly-FULL block of the slot (prompt blocks as
@@ -1873,7 +1896,7 @@ class InferenceEngine:
 
     # -- the host-RAM spill tier (docs/serving.md memory tiers) ------------
 
-    def _spill_payload(self, block_id: int):
+    def _spill_payload(self, block_id: int, record: bool = True):
         """The allocator's spill fetch: one block's device contents as
         host numpy arrays (scales included for quantized pools), or
         None when the device read fails — the spill is an
@@ -1882,7 +1905,10 @@ class InferenceEngine:
         eviction proceeds as a plain discard and the next prefix miss
         recomputes. Never called from ``_reset_device_state``'s
         allocator reset (reset clears without evicting), so a known-
-        poisoned pool is never captured into the host tier."""
+        poisoned pool is never captured into the host tier.
+        ``record=False`` suppresses the recorder's ``spill`` event —
+        :meth:`export_prefix_payloads` reads blocks for migration
+        transport, which is not an eviction."""
         try:
             payload = {"k": np.asarray(self.cache.k[:, block_id]),
                        "v": np.asarray(self.cache.v[:, block_id])}
@@ -1895,7 +1921,7 @@ class InferenceEngine:
             raise
         except Exception:
             return None
-        if self._obs is not None:
+        if record and self._obs is not None:
             self._obs.record(
                 "spill", block=int(block_id),
                 bytes=int(sum(a.nbytes for a in payload.values())))
@@ -2976,6 +3002,7 @@ class InferenceEngine:
                     f"request {entry.request.uid!r} needs {need} blocks "
                     f"to admit but only {self.allocator.num_blocks} exist "
                     "in the pool")
+            self._maybe_checkpoint()
             self._record_tick(admitted, chunked, synced, expired, shed,
                               made)
             return made
@@ -2997,9 +3024,19 @@ class InferenceEngine:
         progressed = bool(made or self._pending is not None
                           or self._num_preemptions > pre_preempt
                           or self._num_quarantines > pre_quarantine)
+        self._maybe_checkpoint()
         self._record_tick(admitted, chunked, synced, expired, shed,
                           progressed)
         return progressed
+
+    def _maybe_checkpoint(self) -> None:
+        """The ``snapshot_interval_ticks`` cadence: refresh
+        ``last_checkpoint`` at the end of every N-th tick. Lightweight
+        by construction (:meth:`checkpoint` never drains), so the
+        steady-state tick pays only the host-side record build."""
+        interval = self.config.snapshot_interval_ticks
+        if interval is not None and self._num_ticks % interval == 0:
+            self.checkpoint()
 
     def _record_tick(self, admitted: int, chunked: bool, synced: bool,
                      expired: int, shed: int, progress: bool) -> None:
@@ -3075,6 +3112,219 @@ class InferenceEngine:
                     for uid, toks in out.items()}
         return out
 
+    # -- the fleet surface (docs/fleet.md) ---------------------------------
+
+    def pop_results(self) -> Dict[str, "RequestResult"]:
+        """Drain every terminal result accumulated so far WITHOUT
+        stepping the engine — the fleet router's per-tick result
+        collection (``run()`` is the drive-to-completion variant; this
+        is the incremental one). Each drained uid becomes reusable,
+        exactly as after ``run()``. Stream events are left alone:
+        streaming callers drain them via :meth:`pop_stream_events`."""
+        out, self.finished = self.finished, {}
+        statuses, self.statuses = self.statuses, {}
+        return {uid: RequestResult(tokens=toks,
+                                   status=statuses.get(uid, "finished"))
+                for uid, toks in out.items()}
+
+    def load(self) -> Dict[str, float]:
+        """The cheap health/load surface a fleet router polls per
+        routing decision — a strict (float-valued) subset of
+        ``stats()``, built without the full dict: queue depth, active
+        lanes, the feasibility-gate service EWMAs, and allocatable
+        headroom (free + evictable blocks, the same measure the
+        degradation ladder reads)."""
+        return {
+            "queue_depth": float(len(self.waiting)),
+            "active_slots": float(
+                sum(s is not None for s in self.slots)),
+            "ewma_prefill_dispatch_s": float(self._ewma_prefill_s or 0.0),
+            "ewma_decode_dispatch_s": float(self._ewma_decode_s or 0.0),
+            "blocks_allocatable": float(self.allocator.num_free
+                                        + self.allocator.num_cached),
+        }
+
+    def probe_prefix(self, hashes: Sequence[str]) -> int:
+        """How many leading blocks of a hash chain this engine could
+        serve WITHOUT recompute: the device prefix index's longest
+        match, extended by the contiguous run of spilled hashes the
+        host tier holds (the same lookup :meth:`_admit` performs, read
+        only — no references taken, no LRU perturbation). The fleet
+        router's prefix-affinity signal: SHA-256 chain hashes are
+        globally comparable, so any replica can score any prompt."""
+        if not self.config.enable_prefix_caching:
+            return 0
+        n = len(self.allocator.lookup_prefix(hashes))
+        if self.spill is not None:
+            while n < len(hashes) and hashes[n] in self.spill:
+                n += 1
+        return n
+
+    def export_requests(self, uids: Optional[Sequence[str]] = None
+                        ) -> List[Dict]:
+        """Drain-and-migrate EXPORT: remove the given waiting/resident
+        requests (all of them when ``uids`` is None) from this engine
+        and return them as snapshot-format entry records —
+        :meth:`import_requests` on another replica resumes them. The
+        in-flight decode is drained first (one host sync — migration
+        is a deliberate synchronous operation), so the records carry
+        every emitted token; each resident's blocks release through
+        the usual deepest-first discipline (cached and re-matchable
+        under prefix caching) and its deadline serializes as remaining
+        budget. Requests already terminal (awaiting ``pop_results``)
+        are NOT exported — their verdicts stay here. Because the
+        records preserve the arrival PRNG identity, a migrated request
+        resumed on a replica with the same seed continues its token
+        stream bit-identically (docs/fleet.md, migration protocol)."""
+        self._drain_decode()
+        want = None if uids is None else {str(u) for u in uids}
+        now = self._clock()
+        records: List[Dict] = []
+        live = sorted((s.admit_seq, i) for i, s in enumerate(self.slots)
+                      if s is not None)
+        for _, i in live:
+            slot = self.slots[i]
+            if want is not None and slot.request.uid not in want:
+                continue
+            records.append(self._entry_record(
+                _QueueEntry(request=slot.request,
+                            arrival=slot.entry.arrival,
+                            generated=self._resume_tokens(slot),
+                            drr_charged=True), now))
+            self.allocator.free(list(reversed(slot.blocks)),
+                                tenant=slot.request.tenant)
+            self.slots[i] = None
+            self._invalidate_lanes()
+            self._release_exported(slot.request)
+        for entry in self.waiting.expel(
+                lambda e: want is None or e.request.uid in want):
+            records.append(self._entry_record(entry, now))
+            self._release_exported(entry.request)
+        self._num_migrated_out += len(records)
+        return records
+
+    def _release_exported(self, request: Request) -> None:
+        """Forget an exported request WITHOUT a terminal transition:
+        it is still alive, just owned by another replica now — no
+        status, no stream sentinel (unlike every other exit path),
+        and fleet-wide uid uniqueness stays the router's job."""
+        self._live_uids.discard(request.uid)
+        self._deadline.pop(request.uid, None)
+        self._prune_tenant_if_idle(request.tenant)
+
+    def import_requests(self, records: Sequence[Dict]) -> int:
+        """Drain-and-migrate IMPORT: enqueue entry records exported by
+        another replica (or read from its checkpoint) into this
+        engine's waiting queue. Records keep their arrival PRNG
+        identity (``_arrival_count`` advances past every imported
+        index so future local arrivals never collide) and their
+        ``drr_charged`` standing — a migrated RESIDENT re-admits ahead
+        of the DRR walk exactly like a preemption requeue, a migrated
+        waiting entry rejoins the walk uncharged. A record without an
+        ``arrival`` (a router re-injecting a post-checkpoint accept it
+        only knows as a Request) gets a fresh local index. Deadlines
+        re-anchor their remaining budget on this clock. Deliberately
+        NO door-quota check: quota enforcement happened at the
+        original door, and failover/migration of already-accepted work
+        must never manufacture a shed (docs/fleet.md, zero-lost
+        contract). Raises ``ValueError`` — before touching anything —
+        if any uid is already live or awaiting drain here."""
+        now = self._clock()
+        for rec in records:
+            uid = rec["uid"]
+            if uid in self._live_uids:
+                raise ValueError(
+                    f"cannot import uid {uid!r}: already waiting or "
+                    "resident in this engine")
+            if uid in self.statuses:
+                raise ValueError(
+                    f"cannot import uid {uid!r}: a terminal result "
+                    "awaits drain here")
+        for rec in records:
+            deadline = rec.get("deadline_remaining_s")
+            req = Request(
+                uid=rec["uid"], prompt=list(rec["prompt"]),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                sampling=SamplingParams(
+                    temperature=rec["sampling"]["temperature"],
+                    top_k=rec["sampling"]["top_k"],
+                    top_p=rec["sampling"]["top_p"]),
+                eos_token_id=rec.get("eos_token_id"),
+                deadline_s=deadline,
+                priority=int(rec.get("priority", 0)),
+                tenant=str(rec.get("tenant", DEFAULT_TENANT)))
+            if deadline is not None:
+                # an already-blown deadline stays blown (<= now)
+                self._deadline[req.uid] = now + float(deadline)
+            arrival = rec.get("arrival")
+            if arrival is None:
+                arrival = self._arrival_count
+            arrival = int(arrival)
+            self._arrival_count = max(self._arrival_count, arrival + 1)
+            self._live_uids.add(req.uid)
+            self._tenant_seen.add(req.tenant)
+            self.waiting.append(_QueueEntry(
+                request=req, arrival=arrival,
+                generated=[int(t) for t in rec.get("generated", ())],
+                enq_t=now, enq_tick=self._num_ticks,
+                drr_charged=bool(rec.get("drr_charged", False))))
+            if self._obs is not None:
+                # anchor the migrated request's timeline exactly as
+                # restore() anchors restored records (requeue, not
+                # enqueue: its submit time belongs to the source)
+                self._obs.note_enqueue(req.uid, tenant=req.tenant,
+                                       priority=req.priority,
+                                       prompt_len=len(req.prompt),
+                                       requeue=True, t=now)
+        self._num_migrated_in += len(records)
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.waiting))
+        return len(records)
+
+    def export_prefix_payloads(self, hashes: Sequence[str]
+                               ) -> Dict[str, Dict]:
+        """The leading run of a hash chain as host payloads — the
+        cross-replica KV transport (docs/fleet.md): device-indexed
+        blocks read out through the spill fetch path, spilled ones
+        through :meth:`~apex_tpu.serving.kv_cache.HostSpillStore.
+        export_entry`. Stops at the first hash served by neither (a
+        payload past a gap is unreachable, like the prefix match) or
+        at the first failed device read (transport is an optimization,
+        never a dependency — the importer just recomputes)."""
+        out: Dict[str, Dict] = {}
+        if not self.config.enable_prefix_caching:
+            return out
+        for h in hashes:
+            b = self.allocator.indexed_block(h)
+            if b is not None:
+                payload = self._spill_payload(b, record=False)
+            elif self.spill is not None:
+                payload = self.spill.export_entry(h)
+            else:
+                payload = None
+            if payload is None:
+                break
+            out[h] = payload
+        return out
+
+    def import_prefix_payloads(self, payloads: Mapping[str, Dict]) -> int:
+        """Seed this engine's spill tier with payloads another replica
+        exported: the next admission matching those chain hashes
+        re-admits them by device upload instead of recompute —
+        token-identical, by the spill-tier equivalence cert. Hashes a
+        device block already serves are skipped (the disjointness
+        invariant); returns how many entries the tier accepted (0 with
+        no spill tier configured — the transport is optional)."""
+        if self.spill is None:
+            return 0
+        n = 0
+        for h, payload in payloads.items():
+            if self.allocator.indexed_block(h) is not None:
+                continue
+            if self.spill.import_entry(h, payload):
+                n += 1
+        return n
+
     # -- crash-consistent snapshot / restore (docs/robustness.md) ---------
 
     def _config_fingerprint(self) -> Dict[str, object]:
@@ -3115,7 +3365,12 @@ class InferenceEngine:
                      # not identity; its cap state rides the overload
                      # section with the same config-guard as the ladder
                      "spec_adapt", "spec_accept_low",
-                     "spec_accept_high"):
+                     "spec_accept_high",
+                     # periodic checkpointing is pure observation of
+                     # host state (checkpoint() never drains or
+                     # mutates scheduling) — restoring into a replica
+                     # with a different cadence changes nothing
+                     "snapshot_interval_ticks"):
             d.pop(knob, None)
         return d
 
@@ -3159,6 +3414,40 @@ class InferenceEngine:
         restored engine re-prefills through the prefix cache and
         rebuilds them (bit-identically, by resume determinism)."""
         self._drain_decode()
+        self._num_snapshots += 1
+        snap = self._build_snapshot()
+        if self._obs is not None:
+            self._obs.record("snapshot", requests=len(snap["requests"]))
+        return snap
+
+    def checkpoint(self) -> Dict[str, object]:
+        """The LIGHTWEIGHT snapshot variant (docs/fleet.md): the same
+        restore()-loadable picture as :meth:`snapshot`, built WITHOUT
+        draining the in-flight decode dispatch — no host sync, so a
+        periodic caller (``snapshot_interval_ticks``, or a fleet
+        router's health loop) never stalls the pipeline. The price is
+        bounded staleness: tokens riding the undrained dispatch (at
+        most ``decode_steps``/``spec_tokens + 1`` per lane) are absent
+        from the records and are RE-DERIVED bit-identically on restore
+        (resume determinism — the records carry prompt + emitted
+        history + the arrival PRNG identity). The result is stored on
+        ``last_checkpoint`` — the failover picture a fleet router
+        reads when this replica dies — and also returned."""
+        self._num_checkpoints += 1
+        snap = self._build_snapshot()
+        snap["lightweight"] = True
+        self.last_checkpoint = snap
+        if self._obs is not None:
+            self._obs.record("snapshot", requests=len(snap["requests"]),
+                             lightweight=True)
+        return snap
+
+    def _build_snapshot(self) -> Dict[str, object]:
+        """The shared snapshot/checkpoint body: pure host-state READS
+        (plus the counter the caller already bumped) — nothing here
+        drains, allocates, or touches scheduling state, which is what
+        makes :meth:`checkpoint` safe on every tick and callable even
+        from a replica whose last dispatch just raised."""
         now = self._clock()
         live = sorted((s.admit_seq, i) for i, s in enumerate(self.slots)
                       if s is not None)
@@ -3174,9 +3463,6 @@ class InferenceEngine:
                             drr_charged=True), now))
         for entry in self.waiting:
             requests.append(self._entry_record(entry, now))
-        self._num_snapshots += 1
-        if self._obs is not None:
-            self._obs.record("snapshot", requests=len(requests))
         snap = {
             "version": 1,
             "config": self._config_fingerprint(),
@@ -3483,6 +3769,12 @@ class InferenceEngine:
             "num_quarantines": self._num_quarantines,
             "num_snapshots": self._num_snapshots,
             "num_restores": self._num_restores,
+            # fleet serving (docs/fleet.md): the periodic lightweight
+            # checkpoint cadence and the drain-and-migrate traffic
+            # through this replica
+            "num_checkpoints": self._num_checkpoints,
+            "num_migrated_in": self._num_migrated_in,
+            "num_migrated_out": self._num_migrated_out,
             # overload observability (docs/robustness.md): queue depth
             # and wait, shed counters, and the degradation ladder —
             # overload must be visible HERE before the first timeout

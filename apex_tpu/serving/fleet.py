@@ -1,0 +1,959 @@
+"""Fleet serving: a crash-tolerant router over N engine replicas.
+
+The millions-of-users story sits one level above a single
+:class:`~apex_tpu.serving.InferenceEngine`: one replica's pool bounds
+its concurrency, and — until now — one replica's crash lost every
+accepted request it held. :class:`FleetRouter` turns N engines
+(in-process here; the replica surface it consumes — ``add_request`` /
+``step()`` / ``load()`` / ``probe_prefix`` / ``export_requests`` /
+``import_requests`` / ``pop_results`` / ``last_checkpoint`` — is a
+thin, host-side, JSON-friendly contract deliberately shaped so a
+process or RPC boundary can slide between router and replica) into one
+serving surface with three properties (docs/fleet.md):
+
+**Prefix-affinity placement.** The engine's prefix index is keyed by
+SHA-256 chain hashes of full-block token contents — globally
+comparable, so the router can compute a prompt's chain and ask EVERY
+replica how many leading blocks it could serve without recompute
+(:meth:`InferenceEngine.probe_prefix`: device index + spill tier).
+Routing scores that affinity against load — queue depth plus active
+lanes, scaled by each replica's service-time EWMAs relative to the
+fleet (the estimators each replica already exports) — so a warm cache
+wins until it is busy, and a cold replica wins once the warm one
+queues. Deterministic: ties break toward the emptier, then
+lower-indexed replica, which is what makes the 1-replica fleet
+bit-identical to the bare engine (certified: outputs, statuses, AND
+schedule counters).
+
+**Crash failover with zero lost accepted requests.** Each replica
+refreshes a lightweight checkpoint every ``snapshot_interval_ticks``
+(:meth:`InferenceEngine.checkpoint` — no drain, bounded staleness).
+The router's health probe declares a replica dead on (a) any exception
+escaping its ``step()`` — including an injected
+:class:`~apex_tpu.utils.faults.FaultPlan` crash, the chaos bench's
+weapon — or (b) ``health_patience`` consecutive no-progress ticks
+while it holds work. Failover re-homes everything: results that
+reached terminal inside the checkpoint are adopted directly;
+checkpointed live entries re-import onto survivors carrying their
+emitted tokens and arrival PRNG identity (tokens emitted after the
+checkpoint re-derive bit-identically — resume determinism); accepted
+requests the checkpoint never saw re-inject fresh from the router's
+own copy. Nothing accepted is ever lost — the ``num_lost_requests``
+gauge computes the invariant and the chaos bench asserts it at zero.
+A request that kills ``max_request_failovers`` replicas in a row is
+the router-level quarantine: it terminal-fails instead of cascading
+through the fleet.
+
+**Drain-and-migrate.** :meth:`migrate` moves live requests off a hot
+or dying replica through the same records
+(:meth:`InferenceEngine.export_requests` drains the in-flight decode,
+releases blocks, and serializes; the target imports and re-prefills
+through its prefix cache — bit-identical resumption under equal
+seeds), optionally shipping the prompt's KV payloads through the spill
+tier (:meth:`InferenceEngine.export_prefix_payloads` →
+``import_prefix_payloads``) so the target re-admits by device upload
+instead of recompute.
+
+Tenancy aggregates fleet-wide: ``FleetConfig.tenant_quotas`` enforces
+waiting-depth / footprint / token-rate bounds against the SUM across
+replicas at the router's door (the cross-replica ledger PR 9
+deferred), each replica's own DRR walk and quotas keep running
+unchanged inside it, and ``stats()["tenants"]`` merges the per-replica
+rows into one ledger.
+
+Delivery semantics: terminal results are exactly-once
+(:meth:`run` / the router's result map dedupe failover re-derivations);
+the streaming feed (:meth:`pop_stream_events`) is exactly-once for
+TOKENS — the router's per-request delivery watermark suppresses the
+tokens a failover re-derivation replays — while a terminal sentinel
+can be lost for a request whose verdict was adopted from a dead
+replica's checkpoint (the corpse's stream is unreadable), so terminal
+truth belongs to :meth:`run`. ``abort`` routes to the owning replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from apex_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    QueueFullError,
+    Request,
+    RequestResult,
+    TenantQuota,
+    TenantThrottledError,
+)
+from apex_tpu.serving.kv_cache import (
+    DEFAULT_TENANT,
+    blocks_needed,
+    seq_block_hashes,
+)
+
+
+class FleetFailedError(RuntimeError):
+    """No replica is alive to serve (or to receive a failover's
+    re-homed requests) and ``FleetConfig.respawn`` is off — the fleet
+    itself is down. Carries nothing recoverable: recovery at this
+    level is the operator's (restart the fleet; accepted-but-unfinished
+    requests are in the router's hands, not lost, but nothing can run
+    them)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """The router's knobs (docs/fleet.md). Engine-level behavior —
+    pool geometry, speculation, overload ladder, per-replica quotas —
+    stays on the shared :class:`EngineConfig` every replica is built
+    from (equal configs, equal seeds: that equality is what makes
+    migration resume bit-identically)."""
+
+    # replicas spawned at construction; each is a full InferenceEngine
+    # over the same (model, params, EngineConfig)
+    num_replicas: int = 2
+    # placement score = affinity_weight * (cached prompt fraction)
+    #                 - load_weight * (relative backlog); see _route
+    affinity_weight: float = 1.0
+    load_weight: float = 1.0
+    # consecutive no-progress ticks (replica holds work, step() keeps
+    # returning False) before the health probe declares it dead. An
+    # exception escaping step() is death immediately.
+    health_patience: int = 2
+    # spawn a fresh engine into a dead replica's slot at failover (the
+    # fresh replica joins the survivors as a re-homing target). Off by
+    # default: a crash loop would respawn forever; on, the fleet
+    # tolerates any number of sequential replica deaths.
+    respawn: bool = False
+    # router-level poison quarantine: a request whose replica dies
+    # this many times is terminal-failed ("failed", tokens kept)
+    # instead of re-injected — one poison request must not cascade
+    # through every replica.
+    max_request_failovers: int = 2
+    # ship the prompt's KV payloads through the spill tier at
+    # migration (export_prefix_payloads -> import_prefix_payloads), so
+    # the target re-admits by upload instead of recompute. Needs a
+    # spill tier (EngineConfig.spill_max_bytes) on both ends; silently
+    # skipped otherwise — transport is an optimization, never a
+    # dependency.
+    migrate_spill_payloads: bool = True
+    # FLEET-WIDE tenant quotas, enforced at the router's door against
+    # aggregates across replicas (waiting depth summed, resident
+    # charge summed, token rate from the router's own estimator).
+    # Independent of EngineConfig.tenant_quotas (per-replica bounds).
+    tenant_quotas: Optional[Mapping[str, TenantQuota]] = None
+    # time constant of the router's per-tenant token-rate estimator
+    # (same math as the engine's: decay exp(-dt/tau), each delivered
+    # token adds 1/tau)
+    tenant_rate_tau_s: float = 1.0
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {self.num_replicas}")
+        for name in ("affinity_weight", "load_weight"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}")
+        if self.health_patience < 1:
+            raise ValueError(
+                f"health_patience must be >= 1, got "
+                f"{self.health_patience}")
+        if self.max_request_failovers < 1:
+            raise ValueError(
+                f"max_request_failovers must be >= 1, got "
+                f"{self.max_request_failovers}")
+        if self.tenant_quotas is not None:
+            for t, q in self.tenant_quotas.items():
+                if not isinstance(q, TenantQuota):
+                    raise ValueError(
+                        f"tenant_quotas[{t!r}] must be a TenantQuota, "
+                        f"got {type(q).__name__}")
+                q.validate(t)
+        if self.tenant_rate_tau_s <= 0:
+            raise ValueError(
+                f"tenant_rate_tau_s must be > 0, got "
+                f"{self.tenant_rate_tau_s}")
+
+
+@dataclasses.dataclass
+class _Replica:
+    """One replica slot: the engine plus the router's health view."""
+
+    engine: Optional[InferenceEngine]
+    alive: bool = True
+    stall_streak: int = 0
+    routed: int = 0
+    error: Optional[str] = None
+
+
+class FleetRouter:
+    """Drive N :class:`InferenceEngine` replicas as one serving
+    surface. Usage mirrors the engine::
+
+        fleet = FleetRouter(model, params, EngineConfig(...),
+                            FleetConfig(num_replicas=3))
+        fleet.add_request(Request("a", prompt))
+        results = fleet.run(return_status=True)
+
+    ``drafters`` / ``faults`` are optional per-replica lists (chaos
+    plans are per-replica by design: killing replica 1 must not fault
+    replica 0); ``clock`` is the shared injectable clock; ``obs`` an
+    optional :class:`~apex_tpu.observability.Observability` whose
+    flight recorder receives the router's ``replica_down`` /
+    ``failover`` / ``migrate`` events (replica engines take their own
+    observers, not this one)."""
+
+    def __init__(self, model, params, engine_config: EngineConfig,
+                 fleet_config: Optional[FleetConfig] = None, *,
+                 drafters: Optional[Sequence] = None,
+                 faults: Optional[Sequence] = None,
+                 clock=None, obs=None):
+        self.model = model
+        self.params = params
+        self.engine_config = engine_config
+        self.config = fleet_config if fleet_config is not None \
+            else FleetConfig()
+        self._clock = time.monotonic if clock is None else clock
+        self._obs = obs
+        if obs is not None:
+            obs.use_clock(self._clock)
+        n = self.config.num_replicas
+        for name, xs in (("drafters", drafters), ("faults", faults)):
+            if xs is not None and len(xs) != n:
+                raise ValueError(
+                    f"{name} must list one entry per replica "
+                    f"({n}), got {len(xs)}")
+        self._drafters = (list(drafters) if drafters is not None
+                          else [None] * n)
+        self._faults = (list(faults) if faults is not None
+                        else [None] * n)
+        self.replicas: List[_Replica] = [self._spawn(i)
+                                         for i in range(n)]
+        # fleet-wide request tracking: owner replica per live uid, the
+        # router's own Request copy (the failover re-injection source
+        # for accepts the checkpoint never saw), terminal results, and
+        # the per-uid failover tally backing the poison quarantine
+        self._owner: Dict[str, int] = {}
+        self._requests: Dict[str, Request] = {}
+        self._results: Dict[str, List[int]] = {}
+        self._statuses: Dict[str, str] = {}
+        self._refails: Dict[str, int] = {}
+        self._stream: List[Tuple[str, int, bool]] = []
+        # the delivery watermark: per live uid, the tokens the router
+        # has already delivered (also the failover re-injection
+        # history for accepts no checkpoint saw) and the owning
+        # engine's emission cursor — a re-homed request re-deriving
+        # tokens the dead replica already streamed resumes BELOW the
+        # watermark, and those replays are suppressed (the stream
+        # feed stays exactly-once for tokens) and never re-counted by
+        # the tenant rate estimator
+        self._delivered: Dict[str, List[int]] = {}
+        self._emit_pos: Dict[str, int] = {}
+        # the fleet-wide tenant rate estimator + the router-door tally
+        self._tenant_rate: Dict[str, float] = {}
+        self._tenant_rate_t: Dict[str, float] = {}
+        self._tenant_status: Dict[str, Dict[str, int]] = {}
+        self._num_ticks = 0
+        self._num_accepted = 0
+        self._num_terminal = 0
+        self._num_routed = 0
+        self._num_affinity_hits = 0
+        self._num_failovers = 0
+        self._num_replicas_down = 0
+        self._num_respawns = 0
+        self._num_migrations = 0
+        self._num_migrated_requests = 0
+        self._num_reinjected_requests = 0
+        self._num_duplicate_results = 0
+        self._num_router_failed = 0
+        self._num_rejected_queue_full = 0
+        self._num_throttled = 0
+
+    def _spawn(self, idx: int) -> _Replica:
+        return _Replica(engine=InferenceEngine(
+            self.model, self.params, self.engine_config,
+            drafter=self._drafters[idx], faults=self._faults[idx],
+            clock=self._clock))
+
+    # -- placement ---------------------------------------------------------
+
+    def _alive(self) -> List[Tuple[int, _Replica]]:
+        return [(i, r) for i, r in enumerate(self.replicas)
+                if r.alive and r.engine is not None]
+
+    def _seq_hashes(self, tokens: Sequence[int]) -> List[str]:
+        return seq_block_hashes(tokens, self.engine_config.block_size)
+
+    def _ranked(self, seq: Sequence[int]) -> List[Tuple[int, int]]:
+        """Alive replicas as ``(index, matched_blocks)``, best placement
+        first (docs/fleet.md, placement score)::
+
+            score(r) = affinity_weight * cached_fraction(r)
+                     - load_weight    * backlog_norm(r)
+
+        ``cached_fraction`` = tokens the replica's prefix index + spill
+        tier could serve without recompute, over the sequence length;
+        ``backlog_norm`` = (queue depth + active lanes) scaled by the
+        replica's service EWMAs relative to the fleet mean (a slow
+        replica's backlog weighs more), over ``max_batch``. Ties break
+        toward the smaller backlog, then the lower index —
+        deterministic, and exactly "replica 0" for a 1-replica fleet.
+        """
+        alive = self._alive()
+        if not alive:
+            raise FleetFailedError(
+                "no replica alive to route to (respawn is off)")
+        hashes = self._seq_hashes(seq)
+        loads = {i: rep.engine.load() for i, rep in alive}
+        svc = {i: (ld["ewma_prefill_dispatch_s"]
+                   + ld["ewma_decode_dispatch_s"])
+               for i, ld in loads.items()}
+        seen = [s for s in svc.values() if s > 0]
+        mean_svc = (sum(seen) / len(seen)) if seen else 0.0
+        bs = self.engine_config.block_size
+        scored = []
+        for i, rep in alive:
+            ld = loads[i]
+            matched = rep.engine.probe_prefix(hashes)
+            affinity = (matched * bs) / max(len(seq), 1)
+            backlog = ld["queue_depth"] + ld["active_slots"]
+            # a replica with no EWMAs yet (cold, or freshly respawned)
+            # weighs its backlog at the neutral 1.0 — NOT 0, which
+            # would make its queue invisible to placement and funnel
+            # every arrival at it until it jams
+            rel = (svc[i] / mean_svc) if (mean_svc > 0
+                                          and svc[i] > 0) else 1.0
+            load = backlog * rel / max(self.engine_config.max_batch, 1)
+            score = (self.config.affinity_weight * affinity
+                     - self.config.load_weight * load)
+            scored.append((-score, backlog, i, matched))
+        scored.sort()
+        return [(i, matched) for _, _, i, matched in scored]
+
+    # -- the fleet door ----------------------------------------------------
+
+    def _tenant_rate_now(self, tenant: str) -> float:
+        r = self._tenant_rate.get(tenant, 0.0)
+        if r == 0.0:
+            return 0.0
+        dt = max(0.0, self._clock() - self._tenant_rate_t[tenant])
+        return r * math.exp(-dt / self.config.tenant_rate_tau_s)
+
+    def _note_tenant_tokens(self, tenant: str, n: int) -> None:
+        now = self._clock()
+        tau = self.config.tenant_rate_tau_s
+        r = self._tenant_rate.get(tenant, 0.0)
+        if r:
+            dt = max(0.0, now - self._tenant_rate_t[tenant])
+            r *= math.exp(-dt / tau)
+        self._tenant_rate[tenant] = r + n / tau
+        self._tenant_rate_t[tenant] = now
+
+    def _door_throttle_reason(self, request: Request) -> Optional[str]:
+        """The FLEET-WIDE tenant-quota door check, against aggregates
+        across replicas — the engine-level door (per-replica quotas)
+        still runs behind it."""
+        quotas = self.config.tenant_quotas
+        q = None if quotas is None else quotas.get(request.tenant)
+        if q is None:
+            return None
+        t = request.tenant
+        alive = self._alive()
+        if q.max_resident_blocks is not None:
+            weight = (alive[0][1].engine._block_weight if alive else 1.0)
+            worst = weight * blocks_needed(
+                len(request.prompt) + request.max_new_tokens,
+                self.engine_config.block_size)
+            if worst > q.max_resident_blocks + 1e-9:
+                return (f"needs up to {worst:g} block-units but is "
+                        f"capped at max_resident_blocks="
+                        f"{q.max_resident_blocks} fleet-wide")
+            # the SUMMED check — the tenant's fractional resident
+            # charge across every alive replica plus this request's
+            # worst case must fit the fleet cap (the engine-level
+            # quota holds an over-charge tenant at admission instead;
+            # a fleet door has no queue to hold in, so it sheds)
+            charge = sum(rep.engine.allocator.tenant_charge(t)
+                         for _, rep in alive)
+            if charge + worst > q.max_resident_blocks + 1e-9:
+                return (f"holds {charge:.2f} resident block-units "
+                        f"across the fleet and this request's worst "
+                        f"case {worst:g} would break "
+                        f"max_resident_blocks={q.max_resident_blocks}")
+        if q.max_waiting is not None:
+            depth = sum(rep.engine.waiting.tenant_depth(t)
+                        for _, rep in alive)
+            if depth >= q.max_waiting:
+                return (f"already holds {depth} waiting entries across "
+                        f"the fleet (max_waiting={q.max_waiting})")
+        if q.tokens_per_s is not None:
+            rate = self._tenant_rate_now(t)
+            if rate > q.tokens_per_s:
+                return (f"is over its fleet-wide token-rate budget "
+                        f"({rate:.1f} > {q.tokens_per_s} tokens/s)")
+        return None
+
+    def add_request(self, request: Request) -> None:
+        """Route one request to the best replica. Raises
+        :class:`TenantThrottledError` when the FLEET-WIDE quota sheds
+        it (terminal ``"throttled"``, drained by :meth:`run` — same
+        contract as the engine door); a replica-level quota shed
+        propagates from the chosen replica likewise. A replica whose
+        queue is full is skipped for the next-best one;
+        :class:`QueueFullError` raises only when EVERY alive replica
+        is full (the fleet's backpressure signal). Duplicate live or
+        undrained uids raise ``ValueError`` — uid uniqueness is
+        fleet-wide."""
+        uid = request.uid
+        if uid in self._owner:
+            raise ValueError(
+                f"request uid {uid!r} is already live in the fleet; "
+                "pick a distinct uid or wait for its terminal result")
+        if uid in self._statuses:
+            raise ValueError(
+                f"request uid {uid!r} has a terminal result "
+                f"({self._statuses[uid]!r}) awaiting drain; run() "
+                "before reusing the uid")
+        reason = self._door_throttle_reason(request)
+        if reason is not None:
+            object.__setattr__(request, "status", "throttled")
+            self._record_result(uid, [], "throttled",
+                                tenant=request.tenant)
+            self._num_throttled += 1
+            if self._obs is not None:
+                self._obs.record("shed", uid=uid, reason="throttled")
+            raise TenantThrottledError(
+                f"request {uid!r} throttled: tenant "
+                f"{request.tenant!r} {reason}")
+        placed = None
+        for idx, matched in self._ranked(list(request.prompt)):
+            try:
+                self.replicas[idx].engine.add_request(request)
+            except QueueFullError:
+                continue
+            placed = (idx, matched)
+            break
+        if placed is None:
+            self._num_rejected_queue_full += 1
+            raise QueueFullError(
+                f"request {uid!r} rejected: every alive replica's "
+                "waiting queue is at max_waiting")
+        idx, matched = placed
+        self._num_routed += 1
+        if matched > 0:
+            self._num_affinity_hits += 1
+        self._owner[uid] = idx
+        self._requests[uid] = request
+        self.replicas[idx].routed += 1
+        self._num_accepted += 1
+
+    def try_add(self, request: Request) -> bool:
+        """Non-raising variant, mirroring the engine's: False on a
+        fleet/replica quota shed or a fleet-wide queue-full;
+        validation errors still raise."""
+        try:
+            self.add_request(request)
+        except (QueueFullError, TenantThrottledError):
+            return False
+        return True
+
+    def abort(self, uid: str) -> bool:
+        """Cancel a live request on its owning replica (terminal
+        ``"cancelled"``, drained like any result). False for a uid the
+        fleet does not currently own."""
+        idx = self._owner.get(uid)
+        if idx is None:
+            return False
+        rep = self.replicas[idx]
+        if not rep.alive or rep.engine is None:
+            return False
+        return rep.engine.abort(uid)
+
+    def owners(self) -> Dict[str, int]:
+        """Live uid -> owning replica index (a copy) — the chaos
+        bench's victim bookkeeping, and an operator's 'where is my
+        request' lookup."""
+        return dict(self._owner)
+
+    # -- the drive loop ----------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return any(rep.alive and rep.engine is not None
+                   and rep.engine.has_work for rep in self.replicas)
+
+    def step(self) -> bool:
+        """One fleet tick: step every alive replica that holds work
+        (catching replica death — exception escape or a
+        ``health_patience`` no-progress streak — with failover), then
+        drain every replica's stream events and terminal results into
+        the router's fleet-wide maps. Returns whether anything
+        progressed (a failover counts: it moved requests)."""
+        self._num_ticks += 1
+        progressed = False
+        for i in range(len(self.replicas)):
+            rep = self.replicas[i]
+            if not rep.alive or rep.engine is None:
+                continue
+            if not rep.engine.has_work:
+                rep.stall_streak = 0
+                continue
+            try:
+                p = rep.engine.step()
+            except Exception as e:  # replica crash containment: any
+                # escape — SimulatedCrash, CacheOutOfBlocks, a real
+                # runtime error — is THIS replica dying, not the fleet
+                self._fail_replica(i, f"{type(e).__name__}: {e}")
+                progressed = True
+                continue
+            if p:
+                rep.stall_streak = 0
+                progressed = True
+            else:
+                rep.stall_streak += 1
+                if rep.stall_streak >= self.config.health_patience:
+                    self._fail_replica(i, "no-progress stall")
+                    progressed = True
+        self._drain_outputs()
+        return progressed
+
+    def run(self, return_status: bool = False):
+        """Drive the fleet until every accepted request is terminal.
+        Same result contract as :meth:`InferenceEngine.run` — ``{uid:
+        tokens}``, or ``{uid: RequestResult}`` with
+        ``return_status=True`` — except fleet-wide. No stall guard is
+        needed here: a stalled replica is a health event (patience,
+        then failover), and a request that stalls every replica hits
+        the ``max_request_failovers`` quarantine, so the loop always
+        terminates (possibly in :class:`FleetFailedError` when the
+        last replica dies with respawn off)."""
+        while self.has_work:
+            self.step()
+        self._drain_outputs()
+        out, self._results = self._results, {}
+        statuses, self._statuses = self._statuses, {}
+        self._stream = []
+        if return_status:
+            return {uid: RequestResult(tokens=toks,
+                                       status=statuses.get(uid,
+                                                           "finished"))
+                    for uid, toks in out.items()}
+        return out
+
+    def pop_stream_events(self) -> List[Tuple[str, int, bool]]:
+        """The fleet-wide streaming feed, concatenated across replicas
+        in drain order. Token events are EXACTLY-ONCE even under
+        failover: a re-homed request re-deriving tokens the dead
+        replica already streamed resumes below the router's delivery
+        watermark, and those replays are suppressed. Terminal
+        ``(uid, -1, True)`` sentinels are best-effort — one can be
+        lost with a crashing replica whose verdict the checkpoint
+        adoption recovers — so terminal truth belongs to :meth:`run`
+        (always exactly-once)."""
+        out, self._stream = self._stream, []
+        return out
+
+    def _drain_outputs(self) -> None:
+        for _, rep in self._alive():
+            self._drain_replica_outputs(rep.engine)
+
+    def _drain_replica_outputs(self, eng: InferenceEngine) -> None:
+        for uid, tok, last in eng.pop_stream_events():
+            req = self._requests.get(uid)
+            if tok >= 0 and req is not None:
+                pos = self._emit_pos.get(uid, 0)
+                self._emit_pos[uid] = pos + 1
+                hist = self._delivered.setdefault(uid, [])
+                if pos < len(hist):
+                    # a failover re-derivation replaying a token the
+                    # dead replica already streamed: below the
+                    # delivery watermark — suppressed, so the stream
+                    # feed stays exactly-once for tokens and the
+                    # tenant rate estimator never double-counts
+                    continue
+                hist.append(int(tok))
+                self._note_tenant_tokens(req.tenant, 1)
+            self._stream.append((uid, tok, last))
+        for uid, res in eng.pop_results().items():
+            self._record_result(uid, res.tokens, res.status)
+
+    def _record_result(self, uid: str, tokens: Sequence[int],
+                       status: str,
+                       tenant: Optional[str] = None) -> None:
+        """First terminal verdict wins, fleet-wide: failover
+        re-derivation can produce a second (bit-identical) result for
+        a uid the router already delivered — counted, dropped."""
+        if uid in self._statuses:
+            self._num_duplicate_results += 1
+            return
+        if tenant is None:
+            req = self._requests.get(uid)
+            tenant = req.tenant if req is not None else DEFAULT_TENANT
+        self._results[uid] = [int(t) for t in tokens]
+        self._statuses[uid] = status
+        tally = self._tenant_status.setdefault(tenant, {})
+        tally[status] = tally.get(status, 0) + 1
+        if uid in self._owner:
+            self._num_terminal += 1
+        self._owner.pop(uid, None)
+        self._requests.pop(uid, None)
+        self._refails.pop(uid, None)
+        self._delivered.pop(uid, None)
+        self._emit_pos.pop(uid, None)
+
+    # -- health, failover, migration ---------------------------------------
+
+    def _fail_replica(self, idx: int, reason: str,
+                      read_host_state: bool = True) -> None:
+        """Declare a replica dead and fail over. ``read_host_state``
+        distinguishes the two death modes: an in-process exception
+        escape leaves the engine OBJECT's host bookkeeping intact —
+        :meth:`InferenceEngine.checkpoint` is pure host reads, so a
+        fresh checkpoint beats a stale one — while a simulated hard
+        kill (:meth:`kill_replica`) forbids touching the corpse and
+        recovery runs from ``last_checkpoint`` alone."""
+        rep = self.replicas[idx]
+        rep.alive = False
+        rep.error = reason
+        self._num_replicas_down += 1
+        if self._obs is not None:
+            self._obs.record("replica_down", replica=idx, reason=reason)
+        snap = None
+        if rep.engine is not None:
+            snap = rep.engine.last_checkpoint
+            if read_host_state:
+                # the engine OBJECT survived (in-process death): its
+                # buffered stream events and terminal results are
+                # intact host state — collect them BEFORE the fresh
+                # checkpoint, or the checkpoint's records would carry
+                # tokens the router never delivered and the delivery
+                # watermark would anchor past them (a silent token
+                # gap in the exactly-once stream feed)
+                try:
+                    self._drain_replica_outputs(rep.engine)
+                except Exception:
+                    pass
+                try:
+                    snap = rep.engine.checkpoint()
+                except Exception:
+                    pass  # keep the periodic checkpoint (or None)
+        if not read_host_state:
+            rep.engine = None   # the process is gone; so is the object
+        if self.config.respawn:
+            # the fresh engine takes the slot and joins the survivors
+            # as a re-homing target; the dead _Replica (and its error)
+            # is dropped — its story lives in the counters/recorder
+            self.replicas[idx] = self._spawn(idx)
+            self._num_respawns += 1
+        self._failover(idx, snap, reason)
+
+    def _failover(self, idx: int, snap: Optional[Dict],
+                  reason: str) -> None:
+        """Re-home everything the dead replica owned (docs/fleet.md,
+        the zero-lost-request contract): adopt checkpointed terminal
+        results, re-import checkpointed live entries (emitted tokens +
+        arrival identity preserved; post-checkpoint tokens re-derive),
+        re-inject post-checkpoint accepts fresh from the router's own
+        Request copies, and terminal-fail any request past its
+        ``max_request_failovers`` budget."""
+        self._num_failovers += 1
+        owned = [uid for uid, o in self._owner.items() if o == idx]
+        owned_set = set(owned)
+        recs = {r["uid"]: r
+                for r in (snap or {}).get("requests", ())}
+        fin = (snap or {}).get("finished") or {}
+        statuses = (snap or {}).get("statuses") or {}
+        # results that went terminal between the router's last drain
+        # and the checkpoint: adopt, never recompute. ONLY for uids
+        # the dead replica still OWNS — a stale checkpoint (e.g. one
+        # predating a full run() cycle) can list finished uids from
+        # finished-and-delivered lifetimes, and adopting those would
+        # resurrect already-delivered results (the dedupe map was
+        # cleared by run()) or even disown a REUSED uid now live on a
+        # survivor, handing the caller the old lifetime's tokens.
+        adopted = 0
+        for uid, toks in fin.items():
+            if uid in owned_set:
+                self._record_result(uid, toks,
+                                    statuses.get(uid, "finished"))
+                adopted += 1
+        rehomed = 0
+        for uid in owned:
+            if uid in self._statuses:
+                continue    # adopted just above
+            self._refails[uid] = self._refails.get(uid, 0) + 1
+            rec = recs.get(uid)
+            if self._refails[uid] > self.config.max_request_failovers:
+                # the router-level quarantine: this request has now
+                # taken down more replicas than it is worth. Keep the
+                # LONGER of the delivered watermark and the checkpoint
+                # record (delivered is never behind a drained stream,
+                # but belt-and-braces beats a result shorter than what
+                # the consumer already received)
+                gen = [int(t) for t in self._delivered.get(uid, ())]
+                if rec and len(rec.get("generated", ())) > len(gen):
+                    gen = [int(t) for t in rec["generated"]]
+                self._num_router_failed += 1
+                self._record_result(uid, gen, "failed")
+                continue
+            if rec is None:
+                # accepted after the checkpoint: the checkpoint never
+                # saw it, but the router holds the Request — re-inject
+                # fresh, CARRYING the tokens the router already
+                # delivered (the watermark history): a fresh arrival
+                # identity redraws only FUTURE tokens, so the stream a
+                # consumer received stays a prefix of the terminal
+                # result instead of being contradicted by re-derived
+                # draws under the new key
+                rec = _request_record(self._requests[uid])
+                rec["generated"] = [int(t) for t in
+                                    self._delivered.get(uid, ())]
+                self._num_reinjected_requests += 1
+            self._place_record(rec)
+            rehomed += 1
+        if self._obs is not None:
+            self._obs.record("failover", replica=idx, reason=reason,
+                             rehomed=rehomed,
+                             adopted=adopted,
+                             checkpointed=len(recs))
+
+    def _place_record(self, rec: Dict) -> None:
+        """Route one entry record to the best surviving replica and
+        import it there. One at a time so each placement sees the
+        queue depth the previous one created."""
+        seq = list(rec["prompt"]) + list(rec.get("generated", ()))[:-1]
+        idx = self._ranked(seq)[0][0]
+        self.replicas[idx].engine.import_requests([rec])
+        self._owner[rec["uid"]] = idx
+        # the new owner resumes emission after the record's history:
+        # anchor the delivery watermark's cursor there, so any
+        # re-derivation of already-streamed tokens is suppressed
+        self._emit_pos[rec["uid"]] = len(rec.get("generated") or ())
+        self.replicas[idx].routed += 1
+
+    def kill_replica(self, idx: int) -> None:
+        """Chaos hook: simulate ABRUPT replica death (SIGKILL
+        semantics) — the engine object is discarded unread, and
+        failover recovers from ``last_checkpoint`` plus the router's
+        own routing record alone. The honest test of the
+        bounded-staleness checkpoint contract; an exception escaping
+        ``step()`` exercises the softer in-process path instead."""
+        rep = self.replicas[idx]
+        if not rep.alive or rep.engine is None:
+            raise ValueError(f"replica {idx} is not alive")
+        self._fail_replica(idx, "killed", read_host_state=False)
+
+    def migrate(self, uids: Optional[Sequence[str]], src: int,
+                dst: Optional[int] = None) -> int:
+        """Drain-and-migrate: move the given live requests (all of the
+        source's, when ``uids`` is None) off replica ``src`` — onto
+        ``dst``, or onto whatever the placement score picks per
+        request. The source exports drained entry records (its
+        in-flight decode synced, blocks released, deadlines serialized
+        as remaining budget); the target imports and re-prefills
+        through its prefix cache, optionally seeded with the prompt's
+        KV payloads through the spill tier
+        (``migrate_spill_payloads``). Equal seeds across the fleet
+        make the migrated request's token stream bit-identical to the
+        unmigrated one (certified). Returns how many requests moved."""
+        rep = self.replicas[src]
+        if not rep.alive or rep.engine is None:
+            raise ValueError(f"replica {src} is not alive")
+        if dst is not None:
+            drep = self.replicas[dst]
+            if dst == src or not drep.alive or drep.engine is None:
+                raise ValueError(
+                    f"migration target {dst} is not a distinct alive "
+                    "replica")
+        records = rep.engine.export_requests(uids)
+        moved = 0
+        for rec in records:
+            seq = (list(rec["prompt"])
+                   + list(rec.get("generated", ()))[:-1])
+            payloads = None
+            if self.config.migrate_spill_payloads:
+                payloads = rep.engine.export_prefix_payloads(
+                    self._seq_hashes(seq))
+            if dst is not None:
+                idx = dst
+            else:
+                ranked = [i for i, _ in self._ranked(seq) if i != src]
+                idx = ranked[0] if ranked else src
+            target = self.replicas[idx].engine
+            if payloads:
+                target.import_prefix_payloads(payloads)
+            target.import_requests([rec])
+            self._owner[rec["uid"]] = idx
+            self._emit_pos[rec["uid"]] = len(rec.get("generated") or ())
+            self.replicas[idx].routed += 1
+            moved += 1
+        if records:
+            self._num_migrations += 1
+            self._num_migrated_requests += moved
+            if self._obs is not None:
+                self._obs.record("migrate", src=src,
+                                 dst=(dst if dst is not None else -1),
+                                 requests=moved)
+        return moved
+
+    def drain_replica(self, src: int, dst: Optional[int] = None,
+                      retire: bool = False) -> int:
+        """Move EVERYTHING off replica ``src`` (one :meth:`migrate`
+        call), optionally retiring it afterwards — the clean shutdown
+        path: no failover, no checkpoint, nothing lost, the replica
+        simply stops receiving placements. Refuses — before touching
+        anything — to retire the LAST alive replica while it holds
+        live requests: with nowhere to migrate them, retirement would
+        strand them alive-but-unservable forever (the one hole the
+        zero-lost gauge cannot see, since the requests stay live).
+        Returns requests moved."""
+        if retire:
+            others = [i for i, _ in self._alive() if i != src]
+            rep = self.replicas[src]
+            if not others and rep.engine is not None \
+                    and rep.engine.has_work:
+                raise ValueError(
+                    f"cannot retire replica {src}: it is the last "
+                    "alive replica and still holds live requests — "
+                    "nothing could ever serve them")
+        moved = self.migrate(None, src, dst)
+        if retire:
+            rep = self.replicas[src]
+            # the export's drain may have FINISHED lanes (EOS/budget
+            # hit inside the synced dispatch): collect those verdicts
+            # now — a retired replica leaves the per-tick drain loop,
+            # and a result stranded on it would never be delivered
+            self._drain_replica_outputs(rep.engine)
+            rep.alive = False
+            rep.error = "retired"
+            if self._obs is not None:
+                self._obs.record("replica_down", replica=src,
+                                 reason="retired")
+        return moved
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """The fleet counters (docs/fleet.md): routing, health,
+        failover, migration, and the zero-lost invariant as a gauge —
+        ``num_lost_requests`` is accepted minus live minus terminal
+        and must read 0 always (the chaos bench asserts it). Nested:
+        ``replicas`` (per-slot health + load view) and ``tenants``
+        (the fleet-wide ledger: per-replica rows summed, the router's
+        door tallies and rate estimator merged in)."""
+        alive = self._alive()
+        reps: Dict[str, Dict[str, object]] = {}
+        tenant_rows: List[Dict[str, Dict[str, object]]] = []
+        for i, rep in enumerate(self.replicas):
+            row: Dict[str, object] = {
+                "alive": bool(rep.alive and rep.engine is not None),
+                "routed": rep.routed,
+                "stall_streak": rep.stall_streak,
+                "error": rep.error,
+            }
+            if rep.engine is not None:
+                es = rep.engine.stats()
+                row.update(rep.engine.load())
+                for k in ("num_checkpoints", "num_migrated_in",
+                          "num_migrated_out", "num_preemptions",
+                          "num_quarantines"):
+                    row[k] = es[k]
+                if rep.alive:
+                    tenant_rows.append(es["tenants"])
+            reps[str(i)] = row
+        return {
+            "num_replicas": len(self.replicas),
+            "replicas_alive": len(alive),
+            "num_ticks": self._num_ticks,
+            "num_accepted": self._num_accepted,
+            "num_routed": self._num_routed,
+            "num_affinity_hits": self._num_affinity_hits,
+            "num_failovers": self._num_failovers,
+            "num_replicas_down": self._num_replicas_down,
+            "num_respawns": self._num_respawns,
+            "num_migrations": self._num_migrations,
+            "num_migrated_requests": self._num_migrated_requests,
+            "num_reinjected_requests": self._num_reinjected_requests,
+            "num_duplicate_results": self._num_duplicate_results,
+            "num_router_failed": self._num_router_failed,
+            "num_rejected_queue_full": self._num_rejected_queue_full,
+            "num_throttled": self._num_throttled,
+            "num_lost_requests": (self._num_accepted - len(self._owner)
+                                  - self._num_terminal),
+            "queue_depth": sum(len(rep.engine.waiting)
+                               for _, rep in alive),
+            "active_slots": sum(
+                sum(s is not None for s in rep.engine.slots)
+                for _, rep in alive),
+            "results_pending": len(self._results),
+            "stream_backlog": len(self._stream),
+            "replicas": reps,
+            "tenants": self._tenant_section(tenant_rows),
+        }
+
+    def _tenant_section(self, tenant_rows) -> Dict[str, Dict[str, object]]:
+        """One fleet-wide row per tenant: the per-replica ledger rows
+        summed (tokens, waiting, residency, fractional charge, engine
+        statuses), the router's own door tallies merged in, and the
+        FLEET rate estimate (the number ``FleetConfig.tenant_quotas``'
+        ``tokens_per_s`` is enforced against)."""
+        agg: Dict[str, Dict[str, object]] = {}
+
+        def row(t: str) -> Dict[str, object]:
+            return agg.setdefault(t, {
+                "tokens": 0, "waiting": 0, "resident_slots": 0,
+                "resident_block_charge": 0.0,
+                "rate_tokens_per_s": round(self._tenant_rate_now(t), 6),
+                "statuses": {},
+            })
+
+        for rows in tenant_rows:
+            for t, er in rows.items():
+                r = row(t)
+                r["tokens"] += er.get("tokens", 0)
+                r["waiting"] += er.get("waiting", 0)
+                r["resident_slots"] += er.get("resident_slots", 0)
+                r["resident_block_charge"] = round(
+                    r["resident_block_charge"]
+                    + er.get("resident_block_charge", 0.0), 6)
+                for s, c in (er.get("statuses") or {}).items():
+                    r["statuses"][s] = r["statuses"].get(s, 0) + c
+        for t, tally in self._tenant_status.items():
+            r = row(t)
+            for s, c in tally.items():
+                # the router's verdicts (fleet-door throttles, failover
+                # quarantines, adopted checkpoints) — kept SEPARATE
+                # from the engine tallies, which never saw them
+                key = f"router_{s}"
+                r["statuses"][key] = r["statuses"].get(key, 0) + c
+        return agg
+
+
+def _request_record(req: Request) -> Dict:
+    """A fresh entry record from the router's own Request copy — the
+    failover path for accepts the dead replica's checkpoint never saw.
+    No ``arrival`` (the target assigns one), no generated tokens
+    (nothing of it was delivered), deadline as its ORIGINAL budget
+    (the router cannot know how much the dead replica burned; erring
+    long keeps the request alive, and the target's gate/expiry still
+    bound it)."""
+    rec = {
+        "uid": req.uid,
+        "prompt": [int(t) for t in req.prompt],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": (None if req.eos_token_id is None
+                         else int(req.eos_token_id)),
+        "sampling": {"temperature": float(req.sampling.temperature),
+                     "top_k": int(req.sampling.top_k),
+                     "top_p": float(req.sampling.top_p)},
+        "priority": int(req.priority),
+        "tenant": str(req.tenant),
+        "generated": [],
+        "drr_charged": False,
+    }
+    if req.deadline_s is not None:
+        rec["deadline_remaining_s"] = float(req.deadline_s)
+    return rec

@@ -534,6 +534,12 @@ class BlockAllocator:
             self.spill_store.discard(block_hash)
         return True
 
+    def indexed_block(self, block_hash: str) -> Optional[int]:
+        """The device block currently serving a chain hash, or None —
+        the read-only point lookup behind the fleet router's affinity
+        probe and the migration transport's device-vs-spill split."""
+        return self._hash_to_block.get(block_hash)
+
     def lookup_prefix(self, hashes: Sequence[str]) -> List[int]:
         """Longest indexed prefix of the hash chain, WITHOUT taking
         references — for capacity checks before committing to an
@@ -753,6 +759,22 @@ def blocks_needed(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))
 
 
+def seq_block_hashes(tokens: Sequence[int],
+                     block_size: int) -> List[str]:
+    """The chain-hash walk over a token sequence's FULL blocks — the
+    one shared builder behind the engine's prefix matching and the
+    fleet router's affinity probe / migration transport (two copies
+    drifting apart would silently break cross-replica hash
+    comparability)."""
+    hashes: List[str] = []
+    prev = None
+    for j in range(len(tokens) // block_size):
+        prev = hash_block_tokens(
+            prev, tokens[j * block_size: (j + 1) * block_size])
+        hashes.append(prev)
+    return hashes
+
+
 class HostSpillStore:
     """The host-RAM spill tier of the prefix cache (docs/serving.md
     memory tiers): a bounded LRU of evicted prefix blocks, keyed by
@@ -836,6 +858,40 @@ class HostSpillStore:
     def discard(self, block_hash: str) -> None:
         if block_hash in self._entries:
             self._drop(block_hash)
+
+    # -- cross-replica transport (docs/fleet.md) ---------------------------
+
+    def export_entry(self, block_hash: str
+                     ) -> Optional[Dict[str, np.ndarray]]:
+        """A deep-copied payload for cross-replica transport (None on
+        miss). A PEEK, not a pop: the entry stays resident here (the
+        exporting replica keeps serving it) and its LRU recency is
+        untouched — chain hashes are globally comparable, so the copy
+        is re-admittable by any engine with the same model/config
+        (:meth:`import_entry` on the receiving store)."""
+        rec = self._entries.get(block_hash)
+        if rec is None:
+            return None
+        return {k: np.array(v, copy=True)
+                for k, v in rec["payload"].items()}
+
+    def import_entry(self, block_hash: str,
+                     payload: Dict[str, np.ndarray],
+                     tenant: str = DEFAULT_TENANT) -> bool:
+        """Insert a payload exported by another replica's store (or
+        read from its device pool): validated for the K/V keys, then
+        standard :meth:`put` semantics — MRU insert, byte-bound LRU
+        eviction. Returns whether the entry is resident after the
+        call. The importing engine's next prefix match re-admits it by
+        device upload, token-identical to recompute (the migration
+        transport's correctness rests on the same re-admit cert as
+        local spill hits)."""
+        missing = [k for k in ("k", "v") if k not in payload]
+        if missing:
+            raise ValueError(
+                f"imported payload for {block_hash!r} is missing "
+                f"{missing} (expected the block's K/V arrays)")
+        return self.put(block_hash, payload, tenant=tenant)
 
     def stats(self) -> Dict[str, int]:
         return {

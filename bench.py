@@ -1907,6 +1907,251 @@ def bench_serving_kv_memory(fast=False):
     }
 
 
+def bench_serving_fleet(fast=False):
+    """Fleet chaos arm (round 12, docs/fleet.md): the crash-tolerance
+    story of the multi-replica router, certified where it matters —
+    a replica KILLED mid-burst under seeded faults.
+
+    Three phases: (0) identity — a 1-replica fleet must be
+    BIT-IDENTICAL to the bare engine (outputs, terminal statuses, and
+    the engine's full ``stats()`` dict, schedule counters included);
+    (1) a 3-replica fleet serves a seeded Poisson-burst trace with
+    shared-prefix groups (the affinity bait) kill-free, for the
+    baseline p99 TTFT and goodput; (2) the SAME trace runs with
+    seeded transient faults on every replica, a ``drain_replica``
+    migration mid-run, and one replica hard-killed mid-burst
+    (``kill_replica`` — recovery from the last periodic checkpoint
+    alone) — the arm asserts ZERO lost accepted requests (every
+    accepted uid terminal exactly once, ``num_lost_requests == 0``),
+    at least one failover and one migration actually fired, and the
+    kill-run victims' p99 TTFT (scheduler ticks, the deterministic
+    unit) holds within its bound of the no-kill baseline.
+    ``vs_baseline`` is kill-run goodput / no-kill goodput.
+    ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import percentile
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  InferenceEngine, Request,
+                                  SamplingParams)
+    from apex_tpu.utils.faults import FaultPlan, FaultSpec
+
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ekw = dict(max_batch=8, block_size=32, num_blocks=256,
+                   max_prefill_len=128, max_seq_len=384,
+                   kv_dtype=jnp.bfloat16, enable_prefix_caching=True,
+                   snapshot_interval_ticks=2, max_waiting=64, seed=11)
+        ticks, rate = 60, 0.8
+        prompt_lens, max_news = (48, 96), (12, 24)
+        kill_tick, drain_tick = 24, 36
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ekw = dict(max_batch=4, block_size=8, num_blocks=64,
+                   max_prefill_len=16, max_seq_len=48,
+                   enable_prefix_caching=True,
+                   snapshot_interval_ticks=2, max_waiting=32, seed=11)
+        ticks = 16 if fast else 28
+        rate = 0.5 if fast else 0.7
+        prompt_lens, max_news = (8, 14), (4, 6)
+        kill_tick = 6 if fast else 10
+        drain_tick = 10 if fast else 16
+    model = GPTLMHeadModel(cfg)
+    # FIXED seeds (not _SALT): the arm asserts on zero-lost, failover
+    # coverage, and a tail-latency bound — the trace must be the same
+    # every round or the asserts flake
+    init_rng = np.random.RandomState(1812)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(init_rng.randint(0, cfg.vocab_size, (1, 8))))
+
+    # shared-prefix groups: requests within a group open with the same
+    # block-aligned head, so affinity routing has something to win
+    prefix_rng = np.random.RandomState(1813)
+    prefixes = [list(prefix_rng.randint(0, cfg.vocab_size,
+                                        prompt_lens[0]))
+                for _ in range(3)]
+
+    def make_trace():
+        rng = np.random.RandomState(1814)
+
+        def make(tick, k):
+            head = prefixes[k % len(prefixes)]
+            tail_len = int(rng.choice(prompt_lens)) - len(head) // 2
+            prompt = head + list(rng.randint(0, cfg.vocab_size,
+                                             max(1, tail_len)))
+            prompt = prompt[:prompt_lens[-1]]
+            samp = (SamplingParams() if k % 2 else
+                    SamplingParams(temperature=1.0, top_k=40))
+            new = int(rng.choice(max_news))
+            # a FACTORY per arrival: each drive builds fresh Request
+            # objects (engines write the terminal status onto them)
+            return lambda: Request(uid=f"q{k}", prompt=list(prompt),
+                                   max_new_tokens=new, sampling=samp)
+
+        return _poisson_burst_trace(
+            rng, ticks=ticks, base_rate=rate, make_request=make,
+            burst_start=ticks // 3, burst_end=2 * ticks // 3,
+            burst_factor=3)
+
+    def drive(router, trace, kill_at=None, kill_idx=None,
+              drain_at=None, drain_idx=None):
+        """Tick the fleet through the trace; per-uid submit/first-token
+        ticks via the stream feed, the kill/drain chaos moves at their
+        scheduled ticks (victims = the killed replica's owners at the
+        kill). Returns (ttft_ticks, accepted, victims, wall_s)."""
+        submit, first = {}, {}
+        accepted, victims = [], None
+        t0 = time.perf_counter()
+        i = tick = 0
+        while i < len(trace) or router.has_work:
+            while i < len(trace) and trace[i][0] <= tick:
+                req = trace[i][1]()
+                if router.try_add(req):
+                    submit[req.uid] = tick
+                    accepted.append(req.uid)
+                i += 1
+            if (kill_at is not None and tick == kill_at
+                    and router.replicas[kill_idx].alive):
+                victims = [u for u, o in router.owners().items()
+                           if o == kill_idx]
+                router.kill_replica(kill_idx)
+            if (drain_at is not None and tick == drain_at
+                    and router.replicas[drain_idx].alive):
+                router.drain_replica(drain_idx)
+            router.step()
+            for uid, tok, last in router.pop_stream_events():
+                if tok >= 0 and uid not in first and uid in submit:
+                    first[uid] = tick
+            tick += 1
+        wall = time.perf_counter() - t0
+        ttft = {u: first[u] - submit[u] for u in first}
+        return ttft, accepted, victims, wall
+
+    def pct(xs, q):
+        return percentile(xs, q) if xs else 0.0
+
+    # -- phase 0: the 1-replica identity cert (constant clock: every
+    # time-derived stat equal by construction, so the FULL stats dict
+    # compares) --
+    ident = make_trace()[:8]
+    bare = InferenceEngine(model, params, EngineConfig(**ekw),
+                           clock=lambda: 0.0)
+    for _, mk in ident:
+        bare.add_request(mk())
+    bare_res = bare.run(return_status=True)
+    bare_stats = bare.stats()
+    fleet1 = FleetRouter(model, params, EngineConfig(**ekw),
+                         FleetConfig(num_replicas=1),
+                         clock=lambda: 0.0)
+    for _, mk in ident:
+        fleet1.add_request(mk())
+    one_res = fleet1.run(return_status=True)
+    identity_ok = (
+        {u: (r.tokens, r.status) for u, r in bare_res.items()}
+        == {u: (r.tokens, r.status) for u, r in one_res.items()}
+        and fleet1.replicas[0].engine.stats() == bare_stats)
+    assert identity_ok, "1-replica fleet diverged from the bare engine"
+
+    # -- phase 1: 3 replicas, no kill — the baseline --
+    trace = make_trace()
+    router = FleetRouter(model, params, EngineConfig(**ekw),
+                         FleetConfig(num_replicas=3))
+    ttft_base, accepted_base, _, wall_base = drive(router, trace)
+    base_res = router.run(return_status=True)
+    base_stats = router.stats()
+    assert set(base_res) >= set(accepted_base), "baseline lost requests"
+    assert base_stats["num_lost_requests"] == 0
+    base_good = sum(len(r.tokens) for r in base_res.values()
+                    if r.status == "finished") / max(wall_base, 1e-9)
+    p99_base = pct(list(ttft_base.values()), 99)
+
+    # -- phase 2: same trace + seeded transient faults on every
+    # replica + a drain-and-migrate + one replica hard-killed
+    # mid-burst --
+    faults = [FaultPlan([FaultSpec(site="prefill", kind="transient",
+                                   every=9)], seed=1815),
+              FaultPlan([FaultSpec(site="decode", kind="transient",
+                                   every=11)], seed=1816),
+              FaultPlan([FaultSpec(site="decode", kind="transient",
+                                   every=13)], seed=1817)]
+    router = FleetRouter(model, params,
+                         EngineConfig(**ekw, max_dispatch_retries=3),
+                         FleetConfig(num_replicas=3),
+                         faults=faults)
+    ttft_kill, accepted, victims, wall_kill = drive(
+        router, trace, kill_at=kill_tick, kill_idx=1,
+        drain_at=drain_tick, drain_idx=2)
+    kill_res = router.run(return_status=True)
+    stats = router.stats()
+    # the headline asserts: zero lost accepted requests, exactly one
+    # terminal per accepted uid, the chaos actually fired
+    missing = set(accepted) - set(kill_res)
+    assert not missing, f"lost accepted requests: {sorted(missing)}"
+    assert stats["num_lost_requests"] == 0, stats["num_lost_requests"]
+    assert len(set(accepted)) == len(accepted)
+    assert stats["num_failovers"] >= 1, "the kill never fired"
+    assert stats["num_migrations"] >= 1, "the drain never migrated"
+    for rep in router.replicas:
+        if rep.alive and rep.engine is not None:
+            rep.engine.check_allocator_integrity()
+    n_finished = sum(r.status == "finished" for r in kill_res.values())
+    assert n_finished > 0
+    # victim tail latency: bounded vs the no-kill baseline (ticks —
+    # the deterministic unit; victims pay the failover re-prefill)
+    victims = victims or []
+    victim_ttft = [ttft_kill[u] for u in victims if u in ttft_kill]
+    p99_victim = pct(victim_ttft, 99)
+    victim_bound = 4.0 * p99_base + 16.0
+    assert p99_victim <= victim_bound, (
+        f"victim p99 TTFT {p99_victim} ticks vs baseline {p99_base} "
+        f"(bound {victim_bound})")
+    kill_good = sum(len(r.tokens) for r in kill_res.values()
+                    if r.status == "finished") / max(wall_kill, 1e-9)
+
+    print(f"# serving fleet: identity OK | baseline p99 TTFT "
+          f"{p99_base:.0f} ticks, goodput {base_good:.1f} tok/s | "
+          f"kill@{kill_tick} (victims {len(victims)}) p99 "
+          f"{p99_victim:.0f} ticks (bound {victim_bound:.0f}), "
+          f"goodput {kill_good:.1f} tok/s | failovers "
+          f"{stats['num_failovers']}, migrations "
+          f"{stats['num_migrated_requests']} req, reinjected "
+          f"{stats['num_reinjected_requests']}, duplicates dropped "
+          f"{stats['num_duplicate_results']}, lost "
+          f"{stats['num_lost_requests']}", file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_fleet_kill_goodput_tok_per_sec"
+                   if on_tpu else
+                   "serving_tiny_fleet_kill_goodput_tok_per_sec"),
+        "value": round(kill_good, 3),
+        "unit": "tokens/sec",
+        # crash-tolerance quality: goodput under a replica kill vs the
+        # kill-free fleet (1.0 = the kill cost nothing)
+        "vs_baseline": round(kill_good / max(base_good, 1e-9), 4),
+        "identity_ok": True,
+        "zero_lost": True,
+        "num_offered": len(trace),
+        "num_accepted": len(accepted),
+        "num_victims": len(victims),
+        "victim_p99_ttft_ticks": round(float(p99_victim), 2),
+        "victim_p99_bound_ticks": round(float(victim_bound), 2),
+        "baseline_p99_ttft_ticks": round(float(p99_base), 2),
+        "num_failovers": int(stats["num_failovers"]),
+        "num_migrations": int(stats["num_migrations"]),
+        "num_migrated_requests": int(stats["num_migrated_requests"]),
+        "num_reinjected_requests":
+            int(stats["num_reinjected_requests"]),
+        "num_duplicate_results": int(stats["num_duplicate_results"]),
+        "num_lost_requests": int(stats["num_lost_requests"]),
+        "num_affinity_hits": int(stats["num_affinity_hits"]),
+        "status_counts": {
+            s: sum(r.status == s for r in kill_res.values())
+            for s in {r.status for r in kill_res.values()}},
+        "allocator_integrity_ok": True,
+    }
+
+
 def bench_train_step(fast=False):
     """Fused train step (apex_tpu.train): the whole global optimizer
     step — amp O2 scaled forward/backward, ``accum_steps`` scanned
@@ -2182,6 +2427,8 @@ def main():
              lambda: bench_serving_multitenant(fast=True)),
             ("bench_serving_kv_memory",
              lambda: bench_serving_kv_memory(fast=True)),
+            ("bench_serving_fleet",
+             lambda: bench_serving_fleet(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -2247,7 +2494,8 @@ def main():
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
                  bench_serving_multitenant, bench_serving_kv_memory,
-                 bench_train_step, bench_obs_pipeline]
+                 bench_serving_fleet, bench_train_step,
+                 bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

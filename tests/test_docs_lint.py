@@ -60,6 +60,36 @@ def test_lint_detects_phantom_observability_names(monkeypatch):
         assert p in missing
 
 
+def test_lint_detects_phantom_fleet_names(monkeypatch):
+    """The fleet surface is checked against docs/fleet.md
+    specifically: a phantom FleetConfig knob or fleet stats() key must
+    be flagged."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+    phantoms = [("FleetConfig field", "phantom_fleet_knob"),
+                ("fleet stats() key", "num_phantom_fleet_counter")]
+
+    def with_phantoms():
+        return orig() + phantoms
+
+    monkeypatch.setattr(mod, "collect_names", with_phantoms)
+    missing = mod.main()
+    for p in phantoms:
+        assert p in missing
+
+
+def test_fleet_names_are_checked_against_their_doc():
+    """A name present only in docs/fleet.md must NOT satisfy a
+    serving-kind check and vice versa — the fleet kinds map to their
+    own doc file."""
+    mod = _load_check_docs()
+    fleet_text = mod._docs_text(mod.FLEET_DOCS)
+    serving_text = mod._docs_text(mod.SERVING_DOCS)
+    # a fleet-only knob name lives in fleet.md, not serving.md
+    assert "migrate_spill_payloads" in fleet_text
+    assert "migrate_spill_payloads" not in serving_text
+
+
 def test_observability_names_are_checked_against_their_doc():
     """A name present only in serving.md must NOT satisfy an
     observability-kind check (and vice versa the real names pass):

@@ -544,6 +544,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_overload_goodput_tokens_per_sec",
         "serving_tiny_multitenant_victim_goodput_tok_per_sec",
         "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
+        "serving_tiny_fleet_kill_goodput_tok_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -600,6 +601,26 @@ def test_bench_smoke_mode_every_section_rc0():
     assert km["spill"]["blocks_spilled"] > 0, km
     assert km["spill"]["reserve_token_identical"] is True, km
     assert math.isfinite(km["value"]) and km["value"] > 0, km
+    # the fleet arm (docs/fleet.md) must prove the crash-tolerance
+    # headline: a 1-replica fleet bit-identical to the bare engine, a
+    # replica killed mid-burst with ZERO lost accepted requests,
+    # failover + drain-and-migrate both actually fired, and the
+    # victims' p99 TTFT inside its bound vs the no-kill baseline — a
+    # silently-skipped kill would be a quiet robustness lie
+    flr = [r for r in records
+           if r.get("metric")
+           == "serving_tiny_fleet_kill_goodput_tok_per_sec"][0]
+    assert flr["identity_ok"] is True, flr
+    assert flr["zero_lost"] is True, flr
+    assert flr["num_lost_requests"] == 0, flr
+    assert flr["num_failovers"] >= 1, flr
+    assert flr["num_migrations"] >= 1, flr
+    assert flr["num_accepted"] > 0, flr
+    assert (flr["victim_p99_ttft_ticks"]
+            <= flr["victim_p99_bound_ticks"]), flr
+    assert flr["status_counts"].get("finished", 0) > 0, flr
+    assert flr["allocator_integrity_ok"] is True, flr
+    assert math.isfinite(flr["vs_baseline"]) and flr["value"] > 0, flr
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -617,7 +638,8 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_serving_kv_memory",
-        "bench_train_step", "bench_obs_pipeline",
+        "bench_serving_fleet", "bench_train_step",
+        "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

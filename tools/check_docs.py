@@ -4,10 +4,12 @@ documented.
 Asserts that every :class:`~apex_tpu.serving.EngineConfig` field, every
 :class:`~apex_tpu.serving.TenantQuota` field, and every top-level
 ``stats()`` counter key of a live engine is NAMED somewhere in
-``docs/serving.md`` or ``docs/robustness.md`` — and that every trace
+``docs/serving.md`` or ``docs/robustness.md`` — that every trace
 event type, flight-recorder event kind, and exported metric name of
-the observability layer is named in ``docs/observability.md`` — so the
-next knob, counter, event, or metric cannot land undocumented. Wired
+the observability layer is named in ``docs/observability.md`` — and
+that every :class:`~apex_tpu.serving.FleetConfig` field and top-level
+fleet ``stats()`` key is named in ``docs/fleet.md`` — so the next
+knob, counter, event, or metric cannot land undocumented. Wired
 in as a tier-1 test (tests/test_docs_lint.py, including a phantom-name
 self-test per surface); also runnable standalone::
 
@@ -26,9 +28,11 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVING_DOCS = ("docs/serving.md", "docs/robustness.md")
 OBS_DOCS = ("docs/observability.md",)
-# kinds whose names belong in docs/observability.md; everything else
-# is the serving surface
+FLEET_DOCS = ("docs/fleet.md",)
+# kinds whose names belong in docs/observability.md / docs/fleet.md;
+# everything else is the serving surface
 OBS_KINDS = ("trace event type", "recorder event kind", "metric")
+FLEET_KINDS = ("FleetConfig field", "fleet stats() key")
 
 
 def _docs_text(files) -> str:
@@ -59,8 +63,8 @@ def collect_names():
         register_engine_metrics,
         register_train_metrics,
     )
-    from apex_tpu.serving import (EngineConfig, InferenceEngine,
-                                  TenantQuota)
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  InferenceEngine, TenantQuota)
 
     names = [("EngineConfig field", f.name)
              for f in dataclasses.fields(EngineConfig)]
@@ -69,10 +73,19 @@ def collect_names():
     cfg = GPTConfig.tiny(dropout=0.0, remat=False)
     model = GPTLMHeadModel(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
-    engine = InferenceEngine(model, params, EngineConfig(
+    engine_cfg = EngineConfig(
         max_batch=2, block_size=4, num_blocks=16, max_prefill_len=8,
-        max_seq_len=16))
+        max_seq_len=16)
+    engine = InferenceEngine(model, params, engine_cfg)
     names += [("stats() key", k) for k in engine.stats()]
+    # the fleet surface (docs/fleet.md): router knobs + its stats keys
+    # — a live 1-replica router, never stepped (stats() is readable
+    # from construction, like the engine's)
+    names += [("FleetConfig field", f.name)
+              for f in dataclasses.fields(FleetConfig)]
+    fleet = FleetRouter(model, params, engine_cfg,
+                        FleetConfig(num_replicas=1))
+    names += [("fleet stats() key", k) for k in fleet.stats()]
     names += [("trace event type", t) for t in TRACE_EVENT_TYPES]
     names += [("recorder event kind", k) for k in RECORDER_EVENT_KINDS]
     registry = MetricsRegistry()
@@ -85,10 +98,15 @@ def collect_names():
 def main():
     serving_text = _docs_text(SERVING_DOCS)
     obs_text = _docs_text(OBS_DOCS)
+    fleet_text = _docs_text(FLEET_DOCS)
     missing = []
     for kind, name in collect_names():
-        text, where = ((obs_text, OBS_DOCS) if kind in OBS_KINDS
-                       else (serving_text, SERVING_DOCS))
+        if kind in OBS_KINDS:
+            text, where = obs_text, OBS_DOCS
+        elif kind in FLEET_KINDS:
+            text, where = fleet_text, FLEET_DOCS
+        else:
+            text, where = serving_text, SERVING_DOCS
         if name not in text:
             missing.append((kind, name))
             print(f"UNDOCUMENTED {kind}: {name!r} appears in neither "

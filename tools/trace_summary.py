@@ -110,6 +110,18 @@ def summarize(dump: Dict) -> str:
     resets = [e for e in rec_events if e.get("kind") == "device_reset"]
     if resets:
         lines.append(f"-- device resets: {len(resets)}")
+    downs = [e for e in rec_events if e.get("kind") == "replica_down"]
+    fails = [e for e in rec_events if e.get("kind") == "failover"]
+    migs = [e for e in rec_events if e.get("kind") == "migrate"]
+    if downs or fails or migs:
+        lines.append(
+            f"-- fleet: {len(downs)} replicas down "
+            f"({', '.join(str(e.get('reason')) for e in downs)}), "
+            f"{len(fails)} failovers re-homing "
+            f"{sum(int(e.get('rehomed', 0)) for e in fails)} requests "
+            f"(+{sum(int(e.get('adopted', 0)) for e in fails)} results "
+            f"adopted from checkpoints), {len(migs)} migrations moving "
+            f"{sum(int(e.get('requests', 0)) for e in migs)} requests")
     spills = [e for e in rec_events if e.get("kind") == "spill"]
     uploads = [e for e in rec_events if e.get("kind") == "spill_upload"]
     if spills or uploads:
