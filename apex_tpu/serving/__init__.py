@@ -59,3 +59,4 @@ from apex_tpu.serving.sampling import (  # noqa: F401
     sample_tokens_per_lane,
     spec_verify_tokens,
 )
+from apex_tpu.utils.integrity import IntegrityError  # noqa: F401

@@ -200,6 +200,16 @@ from apex_tpu.utils.faults import (
     DispatchFailedError,
     SimulatedCrash,
     guarded_call,
+    perturb_json,
+    perturb_payload,
+    perturb_tokens,
+)
+from apex_tpu.utils.integrity import (
+    IntegrityError,
+    payload_checksum,
+    seal_record,
+    verify_payload,
+    verify_record,
 )
 
 from apex_tpu.serving.kv_cache import (
@@ -237,6 +247,12 @@ _LADDER_TOP = 3
 # its cap back (a capped-out engine otherwise never observes
 # acceptance again and stays degraded forever)
 _SPEC_PROBE_EVERY = 16
+# the FaultPlan sites where "corrupt" specs perturb a serialized host
+# artifact (docs/robustness.md, "Data integrity"): the spill tier's
+# write/read paths, the periodic checkpoint, and migration records on
+# the way out / in. Corruption-only — see the construction check.
+_INTEGRITY_SITES = ("spill_put", "spill_get", "checkpoint",
+                    "export", "import")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -525,6 +541,34 @@ class EngineConfig:
     # Operational, not identity: excluded from the restore fingerprint
     # like the retry/overload knobs.
     snapshot_interval_ticks: Optional[int] = None
+    # -- data integrity (docs/robustness.md, "Data integrity") ---------
+    # Verify the SHA-256 content checksums every serialized host
+    # artifact carries — spilled KV blocks at re-admission, migration
+    # records at import, snapshots/checkpoints at restore, transported
+    # KV payloads at spill-tier seeding — at the point of consumption.
+    # A mismatch routes through the artifact's existing degradation
+    # path (a corrupt spill entry is a miss served by recompute, a
+    # corrupt migration import is refused with IntegrityError, a
+    # corrupt snapshot refuses to restore); checksum-less LEGACY
+    # artifacts always load (detection covers sealed artifacts only).
+    # On clean artifacts verification changes nothing — outputs and
+    # schedule counters are bit-identical with it on or off (tested) —
+    # and False skips both the checksumming and the checks, the
+    # byte-identical pre-integrity path. Operational, not identity:
+    # excluded from the restore fingerprint.
+    verify_artifacts: bool = True
+    # Budgeted background scrubbing: every N scheduler ticks the engine
+    # re-verifies scrub_spill_blocks spill-tier entries against their
+    # put-time checksums (round-robin, corrupt entries discarded and
+    # counted) and runs one full allocator/ledger check_integrity
+    # audit — rot is found while recompute is still cheap, and a
+    # silently-corrupted ledger fails loudly instead of mis-charging
+    # forever. None = off (the default). Scrub state is operational:
+    # counters ride stats(), the spill cursor rides the audit-only
+    # spill snapshot section, and both knobs stay out of the restore
+    # fingerprint.
+    scrub_interval_ticks: Optional[int] = None
+    scrub_spill_blocks: int = 4
     seed: int = 0
 
     def __post_init__(self):
@@ -626,6 +670,15 @@ class EngineConfig:
                 f"snapshot_interval_ticks must be >= 1 (or None for no "
                 f"periodic checkpointing), got "
                 f"{self.snapshot_interval_ticks}")
+        if (self.scrub_interval_ticks is not None
+                and self.scrub_interval_ticks < 1):
+            raise ValueError(
+                f"scrub_interval_ticks must be >= 1 (or None for no "
+                f"background scrubbing), got {self.scrub_interval_ticks}")
+        if self.scrub_spill_blocks < 1:
+            raise ValueError(
+                f"scrub_spill_blocks must be >= 1, got "
+                f"{self.scrub_spill_blocks}")
         if self.spec_adapt and self.spec_tokens < 1:
             raise ValueError(
                 "spec_adapt requires spec_tokens >= 1 (there is no "
@@ -1011,6 +1064,24 @@ class InferenceEngine:
                     f"nan faults are not supported at serving sites "
                     f"{sorted(set(bad))}; use transient/crash (the "
                     f"train loop's watchdog owns nan handling)")
+            # the integrity sites are corruption-only (a transient/
+            # crash there would raise from inside host bookkeeping
+            # with no defined recovery), and "corrupt" at a dispatch
+            # site is meaningful only at "decode" (the SDC model: a
+            # wrong token emitted from the drain) — prefill/draft
+            # corruption has no defined consumer
+            bad = [s.site for s in getattr(faults, "specs", ())
+                   if (s.site in _INTEGRITY_SITES
+                       and s.kind != "corrupt")
+                   or (s.kind == "corrupt"
+                       and s.site in ("prefill", "draft"))]
+            if bad:
+                raise ValueError(
+                    f"unsupported fault kind/site combination at "
+                    f"{sorted(set(bad))}: integrity sites "
+                    f"{_INTEGRITY_SITES} take only 'corrupt' specs, "
+                    f"and 'corrupt' dispatch faults are supported at "
+                    f"'decode' only (docs/robustness.md)")
         # deadline clock, injectable so TTL tests are deterministic
         self._clock = time.monotonic if clock is None else clock
         # observability (docs/observability.md): tracer + flight
@@ -1079,8 +1150,24 @@ class InferenceEngine:
         self.spill: Optional[HostSpillStore] = None
         self._spill_hits = 0
         self._spill_misses = 0
+        # -- data integrity (docs/robustness.md) -----------------------
+        self._num_corruptions_detected = 0
+        self._num_import_refusals = 0
+        self._num_scrubs = 0
+        self._num_scrub_blocks_verified = 0
+        # the corrupt seed captured at the decode dispatch, applied to
+        # the drained tokens (the SDC fault model rides the deferred
+        # sync: dispatch fires the plan, drain perturbs the fetch)
+        self._pending_corrupt: Optional[int] = None
         if config.spill_max_bytes is not None:
-            self.spill = HostSpillStore(config.spill_max_bytes)
+            self.spill = HostSpillStore(
+                config.spill_max_bytes,
+                verify=config.verify_artifacts,
+                # the chaos seam exists only when a plan does — the
+                # no-faults engine runs the store's bare read/write
+                corrupt_hook=(self._corrupt_payload_hook
+                              if faults is not None else None),
+                on_corrupt=self._note_corruption)
             self.allocator.attach_spill(self.spill, self._spill_payload)
             # the upload program: one jitted scatter of a host block
             # into the pool (its own jit slot — the prefill/decode
@@ -1338,7 +1425,13 @@ class InferenceEngine:
 
     # -- host-side scheduling ---------------------------------------------
 
-    def add_request(self, request: Request) -> None:
+    def add_request(self, request: Request) -> int:
+        """Validate, door-check, and enqueue one request. Returns the
+        ARRIVAL INDEX assigned to it — the request's PRNG identity
+        (sampled draws key on it), which is what makes a completed
+        request replayable bit-for-bit on any equal-config engine: the
+        fleet router's SDC cross-check (docs/fleet.md) records it per
+        accepted request."""
         n = len(request.prompt)
         if n == 0:
             raise ValueError(f"request {request.uid!r}: empty prompt")
@@ -1418,8 +1511,9 @@ class InferenceEngine:
         if request.deadline_s is not None:
             self._deadline[request.uid] = self._clock() + request.deadline_s
         enq_t = self._clock()
+        arrival = self._arrival_count
         self.waiting.append(_QueueEntry(request=request,
-                                        arrival=self._arrival_count,
+                                        arrival=arrival,
                                         enq_t=enq_t,
                                         enq_tick=self._num_ticks))
         if self._obs is not None:
@@ -1431,6 +1525,7 @@ class InferenceEngine:
         self._arrival_count += 1
         self._queue_depth_peak = max(self._queue_depth_peak,
                                      len(self.waiting))
+        return arrival
 
     def try_add(self, request: Request) -> bool:
         """Non-raising backpressure variant of :meth:`add_request`:
@@ -1894,6 +1989,66 @@ class InferenceEngine:
                                            tenant=slot.request.tenant)
             slot.num_registered += 1
 
+    # -- data integrity (docs/robustness.md, "Data integrity") -------------
+
+    def _corrupt_payload_hook(self, site: str, payload):
+        """The spill store's chaos seam: fire the fault plan at the
+        store's read/write site and, on a ``"corrupt"`` hit, hand back
+        a seeded-deterministically perturbed copy — the bit flip the
+        checksums exist to catch. Identity (and zero extra RNG draws)
+        when no corrupt spec matches."""
+        self.faults.fire(site)
+        seed = self.faults.corrupt_seed(site)
+        if seed is None:
+            return payload
+        return perturb_payload(payload, seed)
+
+    def _maybe_corrupt_record(self, site: str, rec: Dict) -> Dict:
+        """Fire the fault plan at a record-artifact site (checkpoint /
+        export / import) and perturb the record on a corrupt hit —
+        AFTER sealing, so the stale checksum is exactly what detection
+        sees. No-op without a plan."""
+        if self.faults is None:
+            return rec
+        self.faults.fire(site)
+        seed = self.faults.corrupt_seed(site)
+        if seed is None:
+            return rec
+        return perturb_json(rec, seed)
+
+    def _note_corruption(self, site: str, detail: str) -> None:
+        """Count one detected corruption and surface it to the flight
+        recorder — EVERY detection path funnels through here, so
+        ``num_corruptions_detected`` is the one number the chaos certs
+        (and an operator) compare against injected faults."""
+        self._num_corruptions_detected += 1
+        if self._obs is not None:
+            self._obs.record("corruption_detected", site=site,
+                             detail=str(detail))
+
+    def _maybe_scrub(self) -> None:
+        """The budgeted background integrity pass
+        (``scrub_interval_ticks``): re-verify ``scrub_spill_blocks``
+        spill entries round-robin and audit the allocator/ledger
+        invariants exactly. A corrupt spill entry is discarded (a
+        future admission recomputes — the tier's normal miss path); a
+        violated allocator invariant RAISES, because a corrupt ledger
+        has no safe degradation — the process (or the fleet's failover)
+        owns that recovery."""
+        interval = self.config.scrub_interval_ticks
+        if interval is None or self._num_ticks % interval:
+            return
+        self._num_scrubs += 1
+        verified = corrupt = 0
+        if self.spill is not None:
+            verified, corrupt = self.spill.scrub(
+                self.config.scrub_spill_blocks)
+            self._num_scrub_blocks_verified += verified
+        self.check_allocator_integrity()
+        if self._obs is not None:
+            self._obs.record("scrub", verified=int(verified),
+                             corrupt=int(corrupt))
+
     # -- the host-RAM spill tier (docs/serving.md memory tiers) ------------
 
     def _spill_payload(self, block_id: int, record: bool = True):
@@ -2198,7 +2353,32 @@ class InferenceEngine:
                 # recency) — popping first makes that race impossible.
                 up_blocks: List[int] = []
                 if spill_run:
-                    payloads = [self.spill.pop(h) for h in spill_run]
+                    # pop one entry at a time, stopping at the first
+                    # miss — which includes a CHECKSUM MISMATCH (the
+                    # store discards the rotten entry, counts it, and
+                    # returns None): entries past a miss are
+                    # unreachable exactly like the device index, and
+                    # the positions the lost entries would have
+                    # covered fall back to recompute (spill is an
+                    # optimization, never a correctness dependency)
+                    payloads = []
+                    ok_run: List[str] = []
+                    for h in spill_run:
+                        p = self.spill.pop(h)
+                        if p is None:
+                            break
+                        ok_run.append(h)
+                        payloads.append(p)
+                    if len(ok_run) < n_up:
+                        # re-plan: the blocks the lost entries would
+                        # have uploaded are recomputed instead. Total
+                        # fresh allocations are unchanged (need priced
+                        # uploads and tail alike), so the capacity and
+                        # quota checks above still hold exactly.
+                        tail += n_up - len(ok_run)
+                        spill_run, n_up = ok_run, len(ok_run)
+                        m_tok = (len(matched) + n_up) * bs
+                if spill_run:
                     up_blocks = self.allocator.alloc(n_up, tenant=tenant)
                     self.cache = self._upload(
                         self.cache,
@@ -2678,6 +2858,14 @@ class InferenceEngine:
                           if s is not None and s.started]
                 continue
             self._num_decode_dispatches += 1
+            # the SDC fault model (docs/robustness.md): a "corrupt"
+            # spec at the decode site marks THIS dispatch's output for
+            # a seeded wrong-token perturbation at the drain — the
+            # silent wrong-compute no checksum can catch (the fleet's
+            # determinism cross-check exists for exactly this)
+            self._pending_corrupt = (
+                self.faults.corrupt_seed("decode")
+                if self.faults is not None else None)
             if spec:
                 # count drafted tokens HERE, for the lanes this
                 # dispatch actually verifies — plan-time counting would
@@ -2723,6 +2911,7 @@ class InferenceEngine:
         toks, active, uids = self._pending
         self._pending = None
         pending_obs, self._pending_obs = self._pending_obs, None
+        corrupt_seed, self._pending_corrupt = self._pending_corrupt, None
         # the decode EWMA times THIS fetch block only — the remaining
         # in-flight device time at drain. The full launch->drain span
         # would fold caller inter-tick pauses and host scheduling into
@@ -2769,6 +2958,16 @@ class InferenceEngine:
         # each lane's emitted tokens are its non-sentinel prefix (lanes
         # freeze permanently mid-scan, and real token ids are >= 0)
         counts = (toks >= 0).sum(axis=1)
+        if corrupt_seed is not None:
+            # the injected SDC: one emitted token flips to a different
+            # in-vocabulary id. Deliberately applied BEFORE any host
+            # bookkeeping — the wrong token feeds the KV append, the
+            # stream, and the next dispatch's context exactly like a
+            # real flaky-chip sample would, and NOTHING in this engine
+            # can tell (detection is the fleet cross-check's job).
+            toks = perturb_tokens(toks, counts,
+                                  self.model.cfg.vocab_size,
+                                  corrupt_seed)
         if self._obs is not None and pending_obs is not None:
             # trace the dispatch BEFORE replaying its tokens, so each
             # request's timeline reads decode -> drain -> terminal in
@@ -3002,6 +3201,7 @@ class InferenceEngine:
                     f"request {entry.request.uid!r} needs {need} blocks "
                     f"to admit but only {self.allocator.num_blocks} exist "
                     "in the pool")
+            self._maybe_scrub()
             self._maybe_checkpoint()
             self._record_tick(admitted, chunked, synced, expired, shed,
                               made)
@@ -3024,6 +3224,7 @@ class InferenceEngine:
         progressed = bool(made or self._pending is not None
                           or self._num_preemptions > pre_preempt
                           or self._num_quarantines > pre_quarantine)
+        self._maybe_scrub()
         self._maybe_checkpoint()
         self._record_tick(admitted, chunked, synced, expired, shed,
                           progressed)
@@ -3200,6 +3401,12 @@ class InferenceEngine:
                 lambda e: want is None or e.request.uid in want):
             records.append(self._entry_record(entry, now))
             self._release_exported(entry.request)
+        # each record is sealed for the wire (import_requests verifies
+        # it), THEN run through the "export" chaos site — one fire per
+        # record, so a seeded plan can rot exactly the record it means
+        # to (docs/robustness.md, "Data integrity")
+        records = [self._maybe_corrupt_record("export", seal_record(rec))
+                   for rec in records]
         self._num_migrated_out += len(records)
         return records
 
@@ -3228,9 +3435,29 @@ class InferenceEngine:
         original door, and failover/migration of already-accepted work
         must never manufacture a shed (docs/fleet.md, zero-lost
         contract). Raises ``ValueError`` — before touching anything —
-        if any uid is already live or awaiting drain here."""
+        if any uid is already live or awaiting drain here, and
+        :class:`~apex_tpu.utils.integrity.IntegrityError` — likewise
+        before touching anything — if a SEALED record fails its
+        checksum (``verify_artifacts``): a corrupt migration import is
+        REFUSED, so the router's copy (and the source replica) stay
+        the request's truth instead of corrupt state re-entering the
+        fleet. Checksum-less LEGACY records import as before (the
+        fleet seals every hop — export, failover placement — so only
+        hand-built records arrive unsealed)."""
         now = self._clock()
+        if self.faults is not None:
+            # target-side chaos: one "import" fire per received record
+            # (in-transit rot arriving at this replica)
+            records = [self._maybe_corrupt_record("import", rec)
+                       for rec in records]
         for rec in records:
+            if self.config.verify_artifacts:
+                try:
+                    verify_record(rec, "import")
+                except IntegrityError as e:
+                    self._num_import_refusals += 1
+                    self._note_corruption("import", e.detail)
+                    raise
             uid = rec["uid"]
             if uid in self._live_uids:
                 raise ValueError(
@@ -3304,6 +3531,13 @@ class InferenceEngine:
                 payload = None
             if payload is None:
                 break
+            if self.config.verify_artifacts:
+                # a detached content checksum rides the payload dict
+                # (string-valued, skipped by the array checksum and by
+                # the upload path) — the importer verifies the bytes
+                # end to end across the transport
+                payload = dict(payload)
+                payload["checksum"] = payload_checksum(payload)
             out[h] = payload
         return out
 
@@ -3321,6 +3555,18 @@ class InferenceEngine:
         for h, payload in payloads.items():
             if self.allocator.indexed_block(h) is not None:
                 continue
+            payload = dict(payload)
+            checksum = payload.pop("checksum", None)
+            if self.config.verify_artifacts and checksum is not None:
+                try:
+                    verify_payload(payload, checksum, "import_payload")
+                except IntegrityError as e:
+                    # a corrupt transported block is SKIPPED, not
+                    # refused: each payload is an independent cache
+                    # seed, and a skip just means the importer
+                    # recomputes that block (the tier's normal miss)
+                    self._note_corruption("import_payload", e.detail)
+                    continue
             if self.spill.import_entry(h, payload):
                 n += 1
         return n
@@ -3370,7 +3616,15 @@ class InferenceEngine:
                      # host state (checkpoint() never drains or
                      # mutates scheduling) — restoring into a replica
                      # with a different cadence changes nothing
-                     "snapshot_interval_ticks"):
+                     "snapshot_interval_ticks",
+                     # the integrity knobs are operational in the same
+                     # sense: verification and scrubbing are pure
+                     # detection on clean artifacts (certified
+                     # bit-identical on or off), and restoring a
+                     # verify-off snapshot into a verify-on engine is
+                     # exactly the hardening-after-an-incident move
+                     "verify_artifacts", "scrub_interval_ticks",
+                     "scrub_spill_blocks"):
             d.pop(knob, None)
         return d
 
@@ -3434,15 +3688,20 @@ class InferenceEngine:
         ``last_checkpoint`` — the failover picture a fleet router
         reads when this replica dies — and also returned."""
         self._num_checkpoints += 1
-        snap = self._build_snapshot()
-        snap["lightweight"] = True
+        snap = self._build_snapshot(lightweight=True)
+        # the chaos seam (docs/robustness.md): a "corrupt" spec at the
+        # "checkpoint" site rots the just-sealed record — the fleet's
+        # failover verification must then refuse it and fall back to
+        # fresh re-injection
+        snap = self._maybe_corrupt_record("checkpoint", snap)
         self.last_checkpoint = snap
         if self._obs is not None:
             self._obs.record("snapshot", requests=len(snap["requests"]),
                              lightweight=True)
         return snap
 
-    def _build_snapshot(self) -> Dict[str, object]:
+    def _build_snapshot(self, lightweight: bool = False
+                        ) -> Dict[str, object]:
         """The shared snapshot/checkpoint body: pure host-state READS
         (plus the counter the caller already bumped) — nothing here
         drains, allocates, or touches scheduling state, which is what
@@ -3527,10 +3786,14 @@ class InferenceEngine:
             # bytes do not ride a JSON snapshot and restore() never
             # reads this — a restored engine starts with an empty
             # spill tier and re-warms it (hits are an optimization,
-            # never identity; the fingerprint excludes the knob)
+            # never identity; the fingerprint excludes the knob). The
+            # scrub cursor rides here under the same policy: the
+            # restored store is empty, so the walk restarts.
             snap["spill"] = dict(self.spill.stats(), audit_only=True,
                                  hits=int(self._spill_hits),
-                                 misses=int(self._spill_misses))
+                                 misses=int(self._spill_misses),
+                                 scrub_cursor=int(
+                                     self.spill._scrub_cursor))
         if self._obs is not None:
             # AUDIT-ONLY, like the block tables: the flight-recorder
             # tail and trace depth ride along for post-mortems, and
@@ -3545,7 +3808,13 @@ class InferenceEngine:
             if self._obs.tracer is not None:
                 audit["trace_events"] = len(self._obs.tracer)
             snap["observability"] = audit
-        return snap
+        if lightweight:
+            snap["lightweight"] = True
+        # sealed LAST (docs/robustness.md, "Data integrity"): the
+        # embedded checksum covers every field above, survives the
+        # JSON wire format bit-for-bit, and is verified by restore()
+        # and by the fleet router before a failover trusts the record
+        return seal_record(snap)
 
     def restore(self, snap: Dict[str, object]) -> None:
         """Load a :meth:`snapshot` into a FRESHLY constructed engine
@@ -3558,6 +3827,21 @@ class InferenceEngine:
         continues the exact token stream: a restored ``run()`` is
         bit-identical to the uninterrupted one (tested, including
         across processes)."""
+        # integrity FIRST (docs/robustness.md): a sealed snapshot must
+        # verify before ANY field of it is believed — including the
+        # version number, which is itself a corruptible numeric leaf
+        # (acting on it first would mis-report a detected corruption
+        # as "unknown version" and dodge the detection counter). A
+        # corrupt snapshot refuses to restore (the operator recovers
+        # from an older artifact, a fleet router falls back to fresh
+        # re-injection); checksum-less legacy snapshots load as
+        # before — detection covers sealed artifacts only.
+        if self.config.verify_artifacts:
+            try:
+                verify_record(snap, "restore")
+            except IntegrityError as e:
+                self._note_corruption("restore", e.detail)
+                raise
         if snap.get("version") != 1:
             raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
         mine, theirs = self._config_fingerprint(), dict(snap["config"])
@@ -3762,6 +4046,21 @@ class InferenceEngine:
                 self._spill_hits
                 / (self._spill_hits + self._spill_misses)
                 if self._spill_hits + self._spill_misses else 0.0),
+            # the uniform spill refusal/corruption surface + the data-
+            # integrity counters (docs/robustness.md "Data integrity"):
+            # oversize puts the store refused, entries discarded on a
+            # checksum mismatch, total detections across every
+            # verification point, refused migration imports, and the
+            # background scrub's cadence/coverage
+            "num_spill_refused": (self.spill.refused
+                                  if self.spill is not None else 0),
+            "num_spill_corrupt_discards": (
+                self.spill.corrupt_discards
+                if self.spill is not None else 0),
+            "num_corruptions_detected": self._num_corruptions_detected,
+            "num_import_refusals": self._num_import_refusals,
+            "num_scrubs": self._num_scrubs,
+            "num_scrub_blocks_verified": self._num_scrub_blocks_verified,
             # robustness counters (docs/robustness.md): every failure
             # path feeds one, so chaos runs are assertable from stats()
             "num_timeouts": self._num_timeouts,

@@ -61,6 +61,22 @@ deferred), each replica's own DRR walk and quotas keep running
 unchanged inside it, and ``stats()["tenants"]`` merges the per-replica
 rows into one ledger.
 
+**SDC detection** (``sdc_check_interval_ticks``, docs/robustness.md
+"Data integrity"): the silent failure mode the health probe cannot
+see is a replica that computes *wrong tokens* without crashing. The
+router periodically replays a sampled completed request on a second
+replica under its original arrival identity — equal configs +
+arrival-keyed sampling make the streams bit-identical by construction
+— and a divergence, arbitrated by a confirmation replay on an
+independent third replica when one exists (the side the majority
+contradicts is the suspect, owner or verifier alike), retires the
+corrupt replica through the failover path with its host state
+untrusted (fresh re-injection; a corrupt replica's checkpoint proves
+nothing). Failover checkpoints
+and migration records carry content checksums verified before use; a
+corrupt checkpoint reads as no checkpoint, a corrupt migration import
+is refused and the source keeps the request.
+
 Delivery semantics: terminal results are exactly-once
 (:meth:`run` / the router's result map dedupe failover re-derivations);
 the streaming feed (:meth:`pop_stream_events`) is exactly-once for
@@ -76,6 +92,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
+from collections import deque
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from apex_tpu.serving.engine import (
@@ -92,6 +109,17 @@ from apex_tpu.serving.kv_cache import (
     blocks_needed,
     seq_block_hashes,
 )
+from apex_tpu.utils.integrity import (
+    IntegrityError,
+    seal_record,
+    verify_record,
+)
+
+
+# the internal tenant SDC replays run under on the verifier: real
+# tenants' quotas/ledgers must never be charged for verification
+# traffic (see _launch_replay)
+_SDC_TENANT = "__sdc__"
 
 
 class FleetFailedError(RuntimeError):
@@ -148,6 +176,20 @@ class FleetConfig:
     # (same math as the engine's: decay exp(-dt/tau), each delivered
     # token adds 1/tau)
     tenant_rate_tau_s: float = 1.0
+    # -- fleet SDC detection (docs/fleet.md, docs/robustness.md) -------
+    # Every N router ticks, replay one sampled COMPLETED request on a
+    # second replica and compare token streams bit-for-bit: equal
+    # configs + arrival-keyed sampling make any divergence a defect by
+    # construction (a flaky chip, host-RAM rot — the silent failure
+    # mode the health probe cannot see), so the diverging request's
+    # ORIGINAL owner is marked suspect and retired through the
+    # kill/failover path with its host state UNTRUSTED (fresh
+    # re-injection — a corrupt replica's checkpoint proves nothing).
+    # Replays are eligibility-gated to where bit-identity is certified:
+    # greedy requests always, sampled ones only without speculation
+    # (speculative span boundaries are schedule-dependent). None = off
+    # (the default; the cross-check consumes real verifier capacity).
+    sdc_check_interval_ticks: Optional[int] = None
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -176,6 +218,12 @@ class FleetConfig:
             raise ValueError(
                 f"tenant_rate_tau_s must be > 0, got "
                 f"{self.tenant_rate_tau_s}")
+        if (self.sdc_check_interval_ticks is not None
+                and self.sdc_check_interval_ticks < 1):
+            raise ValueError(
+                f"sdc_check_interval_ticks must be >= 1 (or None for "
+                f"no cross-checking), got "
+                f"{self.sdc_check_interval_ticks}")
 
 
 @dataclasses.dataclass
@@ -271,6 +319,23 @@ class FleetRouter:
         self._num_router_failed = 0
         self._num_rejected_queue_full = 0
         self._num_throttled = 0
+        # -- data integrity (docs/robustness.md, "Data integrity") -----
+        # checkpoints the failover verification refused, migration/
+        # failover imports a target refused on a checksum mismatch,
+        # and the SDC cross-check's bookkeeping: per-live-uid arrival
+        # identity (the replay key), a bounded queue of completed
+        # requests awaiting a cross-check, and the in-flight replays
+        # keyed by their private "__sdc__N" uids
+        self._num_corrupt_checkpoints = 0
+        self._num_refused_imports = 0
+        self._num_sdc_checks = 0
+        self._num_sdc_suspects = 0
+        self._sdc_enabled = \
+            self.config.sdc_check_interval_ticks is not None
+        self._sdc_arrivals: Dict[str, int] = {}
+        self._sdc_queue: deque = deque(maxlen=32)
+        self._sdc_pending: Dict[str, Dict] = {}
+        self._sdc_seq = 0
 
     def _spawn(self, idx: int) -> _Replica:
         return _Replica(engine=InferenceEngine(
@@ -431,7 +496,7 @@ class FleetRouter:
         placed = None
         for idx, matched in self._ranked(list(request.prompt)):
             try:
-                self.replicas[idx].engine.add_request(request)
+                arrival = self.replicas[idx].engine.add_request(request)
             except QueueFullError:
                 continue
             placed = (idx, matched)
@@ -445,6 +510,11 @@ class FleetRouter:
         self._num_routed += 1
         if matched > 0:
             self._num_affinity_hits += 1
+        if self._sdc_enabled:
+            # the request's PRNG identity: what a completed token
+            # stream replays from, bit-for-bit, on any equal-config
+            # replica (the cross-check's soundness anchor)
+            self._sdc_arrivals[uid] = int(arrival)
         self._owner[uid] = idx
         self._requests[uid] = request
         self.replicas[idx].routed += 1
@@ -518,6 +588,7 @@ class FleetRouter:
                     self._fail_replica(i, "no-progress stall")
                     progressed = True
         self._drain_outputs()
+        self._maybe_sdc_check()
         return progressed
 
     def run(self, return_status: bool = False):
@@ -558,10 +629,20 @@ class FleetRouter:
 
     def _drain_outputs(self) -> None:
         for _, rep in self._alive():
-            self._drain_replica_outputs(rep.engine)
+            # re-check at use time: draining one replica can RETIRE
+            # another mid-loop (an SDC verdict intercepted in its
+            # results fails the diverging owner, whose engine may
+            # already sit later in this snapshot of the alive list)
+            if rep.alive and rep.engine is not None:
+                self._drain_replica_outputs(rep.engine)
 
     def _drain_replica_outputs(self, eng: InferenceEngine) -> None:
         for uid, tok, last in eng.pop_stream_events():
+            if uid in self._sdc_pending:
+                # cross-check replay traffic: verification-internal,
+                # never delivered (the client already received the
+                # original stream)
+                continue
             req = self._requests.get(uid)
             if tok >= 0 and req is not None:
                 pos = self._emit_pos.get(uid, 0)
@@ -578,6 +659,11 @@ class FleetRouter:
                 self._note_tenant_tokens(req.tenant, 1)
             self._stream.append((uid, tok, last))
         for uid, res in eng.pop_results().items():
+            cand = self._sdc_pending.pop(uid, None)
+            if cand is not None:
+                self._finish_sdc_check(cand, res)
+                continue
+            self._maybe_capture_sdc(uid, res)
             self._record_result(uid, res.tokens, res.status)
 
     def _record_result(self, uid: str, tokens: Sequence[int],
@@ -603,18 +689,228 @@ class FleetRouter:
         self._refails.pop(uid, None)
         self._delivered.pop(uid, None)
         self._emit_pos.pop(uid, None)
+        self._sdc_arrivals.pop(uid, None)
+
+    # -- fleet SDC detection (docs/fleet.md, docs/robustness.md) -----------
+
+    def _maybe_capture_sdc(self, uid: str, res: RequestResult) -> None:
+        """Queue a just-completed request as a cross-check candidate.
+        Eligibility is where bit-identical replay is CERTIFIED: a
+        ``"finished"`` verdict with tokens, a known arrival identity
+        (failover re-injections drew a fresh arrival the router never
+        saw — their streams mix two identities and are not replayable
+        from scratch), and greedy sampling whenever speculation is on
+        (speculative span boundaries are schedule-dependent, so only
+        greedy streams are replica-invariant under speculation)."""
+        if not self._sdc_enabled:
+            return
+        if res.status != "finished" or not res.tokens:
+            return
+        arrival = self._sdc_arrivals.get(uid)
+        req = self._requests.get(uid)
+        owner = self._owner.get(uid)
+        if arrival is None or req is None or owner is None:
+            return
+        if (req.sampling.temperature > 0
+                and self.engine_config.spec_tokens > 0):
+            return
+        self._sdc_queue.append({
+            "uid": uid, "owner": int(owner), "arrival": int(arrival),
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": (None if req.eos_token_id is None
+                             else int(req.eos_token_id)),
+            "sampling": {"temperature": float(req.sampling.temperature),
+                         "top_k": int(req.sampling.top_k),
+                         "top_p": float(req.sampling.top_p)},
+            "priority": int(req.priority), "tenant": str(req.tenant),
+            "tokens": [int(t) for t in res.tokens],
+        })
+
+    def _maybe_sdc_check(self) -> None:
+        """Every ``sdc_check_interval_ticks`` router ticks, replay ONE
+        queued candidate on a replica other than its owner. The replay
+        record carries the ORIGINAL arrival (the PRNG identity), an
+        empty history, and a private ``__sdc__N`` uid; it runs through
+        the verifier's ordinary scheduling and its result is
+        intercepted at the drain — never delivered, never counted as
+        accepted. Equal configs make the verifier's stream a
+        bit-for-bit oracle for the original."""
+        interval = self.config.sdc_check_interval_ticks
+        if interval is None or self._num_ticks % interval:
+            return
+        alive = self._alive()
+        if len(alive) < 2:
+            return
+        while self._sdc_queue:
+            cand = self._sdc_queue.popleft()
+            owner = cand["owner"]
+            rep = self.replicas[owner]
+            if not rep.alive or rep.engine is None:
+                continue    # the owner is already gone; nothing to vet
+            verifiers = [i for i, _ in alive if i != owner]
+            if not verifiers:
+                return
+            if self._launch_replay(cand, verifiers[0]):
+                return      # one replay per interval — the budget
+
+    def _launch_replay(self, cand: Dict, vidx: int) -> bool:
+        """Import one replay record onto replica ``vidx`` and register
+        the pending check. False when the replay record itself was
+        refused in transit (its own "import" corruption) — the check
+        is simply dropped."""
+        ruid = f"__sdc__{self._sdc_seq}"
+        self._sdc_seq += 1
+        rec = seal_record({
+            "uid": ruid, "prompt": list(cand["prompt"]),
+            "max_new_tokens": cand["max_new_tokens"],
+            "eos_token_id": cand["eos_token_id"],
+            "sampling": dict(cand["sampling"]),
+            "arrival": cand["arrival"],
+            "priority": cand["priority"],
+            # a dedicated INTERNAL tenant, not the original: the
+            # replay must not charge the real tenant's resident-block
+            # quota or delivered-token ledger on the verifier
+            # (verification traffic the client never receives would
+            # hold/throttle the tenant's own requests and inflate its
+            # fleet-wide usage row). Unlisted and transient, so the
+            # engine's idle-tenant pruning drops the row afterwards.
+            # Tenant is never a sampling input, so replay identity is
+            # unaffected.
+            "tenant": _SDC_TENANT,
+            "generated": [],
+            # out-of-band of the verifier's DRR walk, like a
+            # requeue: verification traffic must not contend for
+            # (or distort) tenant fairness
+            "drr_charged": True,
+        })
+        try:
+            self.replicas[vidx].engine.import_requests([rec])
+        except IntegrityError:
+            return False
+        cand["verifier"] = vidx
+        self._sdc_pending[ruid] = cand
+        self._num_sdc_checks += 1
+        return True
+
+    def _finish_sdc_check(self, cand: Dict, res: RequestResult) -> None:
+        """Compare a drained replay against the original verdict. A
+        non-"finished" replay (the verifier shed or timed it out) is
+        inconclusive — no verdict, no retirement; a VOIDED check (the
+        owner died of something else while the replay was in flight —
+        or a respawn took its slot, which must not inherit the
+        suspicion) is swallowed verdict-free. A token mismatch is
+        PROOF of a defect (equal configs, equal PRNG identity) but
+        does not say on WHICH side, so divergence ARBITRATES when a
+        third replica exists: one confirmation replay on a replica
+        independent of both owner and first verifier, and the side the
+        majority contradicts retires —
+
+        - confirmation == original  ⇒ the first VERIFIER diverged
+          alone: it is the corrupt one;
+        - confirmation != original  ⇒ two independent replicas
+          contradict the owner's stream: the OWNER is the corrupt one.
+
+        With only two replicas alive there is no arbiter and the owner
+        retires (the documented asymmetry: a corrupt verifier then
+        costs one healthy replica, and its own results keep failing
+        later rounds). Retirement goes through the failover path with
+        host state UNTRUSTED — checkpoints and buffered outputs of a
+        silently-corrupting replica prove nothing, so its live
+        requests re-inject fresh from the router's own copies (zero
+        lost accepted requests, the PR 12 cert)."""
+        if cand.get("void") or res.status != "finished":
+            return
+        replay = [int(t) for t in res.tokens]
+        if replay == cand["tokens"]:
+            if cand.get("confirm") \
+                    and cand.get("first_verifier") is not None:
+                # the arbiter sides with the original: the FIRST
+                # verifier is the one that computed a wrong stream
+                self._retire_suspect(cand["first_verifier"],
+                                     cand["uid"])
+            return
+        if not cand.get("confirm"):
+            arbiters = [i for i, _ in self._alive()
+                        if i != cand["owner"]
+                        and i != cand.get("verifier")]
+            # a failed confirm launch (the replay record itself rotted
+            # in transit) must NOT drop the proven divergence: fall
+            # through to the no-arbiter verdict instead
+            if arbiters and self._launch_replay(
+                    dict(cand, confirm=True,
+                         first_verifier=cand.get("verifier")),
+                    arbiters[0]):
+                return
+        self._retire_suspect(cand["owner"], cand["uid"])
+
+    def _retire_suspect(self, idx: int, uid: str) -> None:
+        rep = self.replicas[idx]
+        if not rep.alive or rep.engine is None:
+            return  # a verdict against a corpse is stale evidence
+        self._num_sdc_suspects += 1
+        if self._obs is not None:
+            self._obs.record("sdc_suspect", replica=idx, uid=uid)
+        self._fail_replica(idx, "sdc divergence",
+                           read_host_state=False,
+                           trust_state=False)
+
+    def _note_refused_import(self, uid, detail: str) -> None:
+        """The one funnel for refused-import bookkeeping (counter +
+        recorder), shared by the migrate, failover-placement, and
+        source-requeue refusal paths."""
+        self._num_refused_imports += 1
+        if self._obs is not None:
+            self._obs.record("corruption_detected", site="import",
+                             uid=uid, detail=str(detail))
+
+    def _drop_sdc_for_replica(self, idx: int) -> None:
+        """Forget cross-check state touching a dead replica: queued
+        candidates whose owner it was (nothing left to vet — and a
+        respawn into the slot must not inherit their suspicion) and
+        in-flight replays it was verifying (their results died with
+        it). Replays whose OWNER died stay in the pending map but are
+        VOIDED: the replay request itself is still live on its
+        verifier, so its eventual result must still be intercepted
+        (swallowed verdict-free) — dropping the map entry would let a
+        ``__sdc__`` uid fall through to the client-facing result maps."""
+        if not self._sdc_enabled:
+            return
+        self._sdc_queue = deque(
+            (c for c in self._sdc_queue if c["owner"] != idx),
+            maxlen=self._sdc_queue.maxlen)
+        self._sdc_pending = {
+            r: c for r, c in self._sdc_pending.items()
+            if c.get("verifier") != idx}
+        for c in self._sdc_pending.values():
+            if c["owner"] == idx:
+                c["void"] = True
+            elif c.get("confirm") and c.get("first_verifier") == idx:
+                # the accused first verifier died of something else
+                # mid-arbitration: its half of the verdict is moot (a
+                # respawn into the slot must not inherit the blame);
+                # the owner half still stands
+                c["first_verifier"] = None
 
     # -- health, failover, migration ---------------------------------------
 
     def _fail_replica(self, idx: int, reason: str,
-                      read_host_state: bool = True) -> None:
+                      read_host_state: bool = True,
+                      trust_state: bool = True) -> None:
         """Declare a replica dead and fail over. ``read_host_state``
         distinguishes the two death modes: an in-process exception
         escape leaves the engine OBJECT's host bookkeeping intact —
         :meth:`InferenceEngine.checkpoint` is pure host reads, so a
         fresh checkpoint beats a stale one — while a simulated hard
         kill (:meth:`kill_replica`) forbids touching the corpse and
-        recovery runs from ``last_checkpoint`` alone."""
+        recovery runs from ``last_checkpoint`` alone.
+        ``trust_state=False`` is the SDC-suspect mode: nothing the
+        replica wrote is believed — no drain, no checkpoint (its
+        records carry tokens a corrupt chip computed) — and every
+        live request it owned re-injects FRESH from the router's own
+        copies. Whatever checkpoint IS used must verify its content
+        checksum first (``verify_artifacts``): a corrupt checkpoint
+        reads as no checkpoint, the same fresh re-injection path."""
         rep = self.replicas[idx]
         rep.alive = False
         rep.error = reason
@@ -622,7 +918,7 @@ class FleetRouter:
         if self._obs is not None:
             self._obs.record("replica_down", replica=idx, reason=reason)
         snap = None
-        if rep.engine is not None:
+        if rep.engine is not None and trust_state:
             snap = rep.engine.last_checkpoint
             if read_host_state:
                 # the engine OBJECT survived (in-process death): its
@@ -642,6 +938,16 @@ class FleetRouter:
                     pass  # keep the periodic checkpoint (or None)
         if not read_host_state:
             rep.engine = None   # the process is gone; so is the object
+        # integrity gate (docs/robustness.md): the failover picture is
+        # believed only if its content checksum verifies — a corrupt
+        # checkpoint is refused and recovery falls back to the fresh
+        # re-injection path the zero-lost cert already covers
+        snap = self._checked_checkpoint(snap)
+        # purge cross-check state touching the corpse AFTER its
+        # buffered outputs were drained (a completed replay verdict in
+        # that buffer was still intercepted above), so nothing of a
+        # replay uid can ever leak into the client-facing result maps
+        self._drop_sdc_for_replica(idx)
         if self.config.respawn:
             # the fresh engine takes the slot and joins the survivors
             # as a re-homing target; the dead _Replica (and its error)
@@ -649,6 +955,25 @@ class FleetRouter:
             self.replicas[idx] = self._spawn(idx)
             self._num_respawns += 1
         self._failover(idx, snap, reason)
+
+    def _checked_checkpoint(self, snap: Optional[Dict]
+                            ) -> Optional[Dict]:
+        """Verify a failover checkpoint's embedded checksum before ANY
+        of it is believed (adoption, re-imports). Returns None — "no
+        checkpoint", the certified fresh-re-inject path — on a
+        mismatch; checksum-less legacy checkpoints pass through (the
+        detection guarantee covers sealed artifacts only)."""
+        if snap is None or not self.engine_config.verify_artifacts:
+            return snap
+        try:
+            verify_record(snap, "checkpoint")
+        except IntegrityError as e:
+            self._num_corrupt_checkpoints += 1
+            if self._obs is not None:
+                self._obs.record("corruption_detected",
+                                 site="checkpoint", detail=e.detail)
+            return None
+        return snap
 
     def _failover(self, idx: int, snap: Optional[Dict],
                   reason: str) -> None:
@@ -720,18 +1045,60 @@ class FleetRouter:
                              adopted=adopted,
                              checkpointed=len(recs))
 
-    def _place_record(self, rec: Dict) -> None:
+    def _place_record(self, rec: Dict, retried: bool = False) -> None:
         """Route one entry record to the best surviving replica and
         import it there. One at a time so each placement sees the
-        queue depth the previous one created."""
+        queue depth the previous one created. The record is SEALED for
+        this hop (checkpoint-internal records were verified as part of
+        the checkpoint, but travel unsealed); a target that refuses it
+        on a checksum mismatch (in-transit rot) triggers ONE retry
+        from the router's own clean ``Request`` copy — the same fresh
+        re-injection the rec-is-None failover path certifies, losing
+        checkpoint history beyond the delivered watermark but losing
+        no request — and only a second refusal (or a record the router
+        holds no copy of) terminal-fails with what the router already
+        delivered: the poison-quarantine verdict, still zero-lost (a
+        verdict is not a loss)."""
+        uid = rec["uid"]
         seq = list(rec["prompt"]) + list(rec.get("generated", ()))[:-1]
         idx = self._ranked(seq)[0][0]
-        self.replicas[idx].engine.import_requests([rec])
-        self._owner[rec["uid"]] = idx
+        try:
+            self.replicas[idx].engine.import_requests([seal_record(rec)])
+        except IntegrityError as e:
+            self._note_refused_import(uid, e.detail)
+            req = self._requests.get(uid)
+            if not retried and req is not None:
+                fresh = _request_record(req)
+                fresh["generated"] = [int(t) for t in
+                                      self._delivered.get(uid, ())]
+                self._num_reinjected_requests += 1
+                self._place_record(fresh, retried=True)
+                return
+            gen = [int(t) for t in self._delivered.get(uid, ())]
+            if len(rec.get("generated") or ()) > len(gen):
+                gen = [int(t) for t in rec["generated"]]
+            self._num_router_failed += 1
+            self._record_result(uid, gen, "failed")
+            return
+        self._owner[uid] = idx
+        if self._sdc_enabled:
+            # cross-check eligibility survives a re-homing only when
+            # the verdict would still be ATTRIBUTABLE: the arrival
+            # identity must be known (a fresh re-injection draws one
+            # the router never learns) AND the record must carry no
+            # generated history — tokens computed by the PREVIOUS
+            # owner ride the record, so the final stream mixes two
+            # replicas' compute and a divergence could blame a healthy
+            # replica for a dead one's corruption
+            if (rec.get("arrival") is not None
+                    and not rec.get("generated")):
+                self._sdc_arrivals[uid] = int(rec["arrival"])
+            else:
+                self._sdc_arrivals.pop(uid, None)
         # the new owner resumes emission after the record's history:
         # anchor the delivery watermark's cursor there, so any
         # re-derivation of already-streamed tokens is suppressed
-        self._emit_pos[rec["uid"]] = len(rec.get("generated") or ())
+        self._emit_pos[uid] = len(rec.get("generated") or ())
         self.replicas[idx].routed += 1
 
     def kill_replica(self, idx: int) -> None:
@@ -771,6 +1138,7 @@ class FleetRouter:
         records = rep.engine.export_requests(uids)
         moved = 0
         for rec in records:
+            uid = rec["uid"]
             seq = (list(rec["prompt"])
                    + list(rec.get("generated", ()))[:-1])
             payloads = None
@@ -785,9 +1153,31 @@ class FleetRouter:
             target = self.replicas[idx].engine
             if payloads:
                 target.import_prefix_payloads(payloads)
-            target.import_requests([rec])
-            self._owner[rec["uid"]] = idx
-            self._emit_pos[rec["uid"]] = len(rec.get("generated") or ())
+            try:
+                target.import_requests([rec])
+            except IntegrityError as e:
+                # the record rotted between the source's seal and the
+                # target's verify: REFUSED — corrupt state never
+                # re-enters the fleet, and the request stays the
+                # source's (re-injected there fresh from the router's
+                # own clean copy, carrying the delivered watermark)
+                self._note_refused_import(uid, e.detail)
+                self._requeue_refused(rec, src)
+                continue
+            if uid in self._sdc_pending:
+                # a cross-check replay swept up by the drain: result
+                # interception is by uid, so just re-point its
+                # verifier — replays are never owner-tracked
+                self._sdc_pending[uid]["verifier"] = idx
+            else:
+                self._owner[uid] = idx
+                self._emit_pos[uid] = len(rec.get("generated") or ())
+                if rec.get("generated"):
+                    # migrated WITH history: the final stream mixes
+                    # the source's compute with the target's, so an
+                    # eventual divergence could not be attributed to
+                    # either — it leaves the cross-check pool
+                    self._sdc_arrivals.pop(uid, None)
             self.replicas[idx].routed += 1
             moved += 1
         if records:
@@ -798,6 +1188,39 @@ class FleetRouter:
                                  dst=(dst if dst is not None else -1),
                                  requests=moved)
         return moved
+
+    def _requeue_refused(self, rec: Dict, src: int) -> None:
+        """A migration import was refused on a checksum mismatch: the
+        exported record is untrustworthy, so the SOURCE keeps the
+        request — re-injected fresh from the router's own Request copy
+        (the same record the failover path certifies), carrying the
+        delivered-token watermark so the client's stream stays a
+        prefix of the terminal result. If even that hop is refused
+        (corruption on the source's own import path), the request
+        terminal-fails with its delivered tokens — the quarantine
+        verdict, never a loss."""
+        uid = rec.get("uid")
+        req = self._requests.get(uid)
+        rep = self.replicas[src]
+        if req is None or not rep.alive or rep.engine is None:
+            # a replay record (no router copy): the check is dropped
+            self._sdc_pending.pop(uid, None)
+            return
+        fresh = _request_record(req)
+        fresh["generated"] = [int(t) for t in
+                              self._delivered.get(uid, ())]
+        try:
+            rep.engine.import_requests([seal_record(fresh)])
+        except IntegrityError as e:
+            self._note_refused_import(uid, e.detail)
+            self._num_router_failed += 1
+            self._record_result(uid, list(fresh["generated"]), "failed")
+            return
+        self._owner[uid] = src
+        self._emit_pos[uid] = len(fresh["generated"])
+        self._num_reinjected_requests += 1
+        self._sdc_arrivals.pop(uid, None)
+        rep.routed += 1
 
     def drain_replica(self, src: int, dst: Optional[int] = None,
                       retire: bool = False) -> int:
@@ -881,6 +1304,13 @@ class FleetRouter:
             "num_router_failed": self._num_router_failed,
             "num_rejected_queue_full": self._num_rejected_queue_full,
             "num_throttled": self._num_throttled,
+            # data integrity (docs/robustness.md "Data integrity"):
+            # refused failover checkpoints, refused migration/failover
+            # imports, and the SDC cross-check's replay/verdict tally
+            "num_corrupt_checkpoints": self._num_corrupt_checkpoints,
+            "num_refused_imports": self._num_refused_imports,
+            "num_sdc_checks": self._num_sdc_checks,
+            "num_sdc_suspects": self._num_sdc_suspects,
             "num_lost_requests": (self._num_accepted - len(self._owner)
                                   - self._num_terminal),
             "queue_depth": sum(len(rep.engine.waiting)

@@ -72,11 +72,13 @@ from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from apex_tpu.utils.integrity import payload_checksum
 
 # the tenant every un-labelled caller is accounted to — single-tenant
 # traffic runs entirely under this id and behaves exactly like the
@@ -795,19 +797,42 @@ class HostSpillStore:
     The store is an OPTIMIZATION tier, never identity: entries are
     audit-only in ``snapshot()`` (restore never reads them), a miss
     just means recompute, and a hit is token-identical to recompute
-    (the re-admit equivalence cert in tests/test_kv_memory.py)."""
+    (the re-admit equivalence cert in tests/test_kv_memory.py).
 
-    def __init__(self, max_bytes: int):
+    **Integrity** (docs/robustness.md, "Data integrity"): with
+    ``verify=True`` every entry stores a SHA-256 content checksum
+    taken at :meth:`put`, re-checked at every read (:meth:`pop` /
+    :meth:`export_entry`) and by the background :meth:`scrub` — a
+    mismatch (host-RAM rot, a corrupted copy) discards the entry,
+    counts it (``corrupt_discards``), reports it through
+    ``on_corrupt(site, block_hash)``, and reads as a plain miss: the
+    tier's whole contract is that a miss means recompute, so detection
+    degrades to correctness, never to an error. ``corrupt_hook(site,
+    payload) -> payload`` is the chaos seam (the engine wires its
+    :class:`~apex_tpu.utils.faults.FaultPlan`'s ``"spill_put"`` /
+    ``"spill_get"`` corrupt sites through it); with ``verify=False``
+    no checksum is taken and reads trust their bytes — byte-identical
+    to the pre-integrity store."""
+
+    def __init__(self, max_bytes: int, verify: bool = True,
+                 corrupt_hook=None, on_corrupt=None):
         if max_bytes < 1:
             raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self.max_bytes = int(max_bytes)
+        self.verify = bool(verify)
+        self._corrupt_hook = corrupt_hook
+        self._on_corrupt = on_corrupt
         # hash -> {"payload": dict of np arrays, "tenant": str,
-        # "bytes": int}; insertion order = LRU order (puts re-insert)
+        # "bytes": int, "checksum": str|None}; insertion order = LRU
+        # order (puts re-insert)
         self._entries: "OrderedDict[str, Dict[str, object]]" = \
             OrderedDict()
         self.total_bytes = 0
         self.puts = 0          # lifetime blocks spilled in
         self.evictions = 0     # entries dropped by the byte bound
+        self.refused = 0           # oversize entries never admitted
+        self.corrupt_discards = 0  # entries dropped on checksum mismatch
+        self._scrub_cursor = 0     # round-robin position of scrub()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -828,15 +853,23 @@ class HostSpillStore:
         evicting LRU entries past the byte bound. Returns whether the
         entry is resident after the call."""
         nbytes = sum(int(a.nbytes) for a in payload.values()
-                     if a is not None)
+                     if isinstance(a, np.ndarray))
         if block_hash in self._entries:
             self._drop(block_hash)
         self.puts += 1
         if nbytes > self.max_bytes:
             self.evictions += 1
+            self.refused += 1
             return False
+        # checksum the TRUE bytes first, then let the chaos hook rot
+        # them — exactly the order real corruption happens in (the
+        # checksum is taken at the source; the flip happens in RAM)
+        checksum = payload_checksum(payload) if self.verify else None
+        if self._corrupt_hook is not None:
+            payload = self._corrupt_hook("spill_put", payload)
         self._entries[block_hash] = {
-            "payload": payload, "tenant": tenant, "bytes": nbytes}
+            "payload": payload, "tenant": tenant, "bytes": nbytes,
+            "checksum": checksum}
         self.total_bytes += nbytes
         while self.total_bytes > self.max_bytes:
             _, rec = self._entries.popitem(last=False)
@@ -844,16 +877,37 @@ class HostSpillStore:
             self.evictions += 1
         return block_hash in self._entries
 
+    def _read_ok(self, block_hash: str, payload, checksum) -> bool:
+        """The shared read-side verification: recompute the payload's
+        checksum against the one taken at put. A mismatch counts as a
+        corrupt discard and reports through ``on_corrupt`` — the
+        caller turns it into a miss (recompute serves the request)."""
+        if not self.verify or checksum is None:
+            return True
+        if payload_checksum(payload) == checksum:
+            return True
+        self.corrupt_discards += 1
+        if self._on_corrupt is not None:
+            self._on_corrupt("spill_get", block_hash)
+        return False
+
     def pop(self, block_hash: str) -> Optional[Dict[str, np.ndarray]]:
-        """Remove and return a block's payload (None on miss) — the
-        re-admission read. Popping (rather than peeking) keeps the
-        store disjoint from the device index: the caller is about to
-        upload and register a device block under this hash."""
+        """Remove and return a block's payload (None on miss OR on a
+        checksum mismatch — a corrupt entry is discarded, counted, and
+        served by recompute) — the re-admission read. Popping (rather
+        than peeking) keeps the store disjoint from the device index:
+        the caller is about to upload and register a device block
+        under this hash."""
         rec = self._entries.get(block_hash)
         if rec is None:
             return None
         self._drop(block_hash)
-        return rec["payload"]
+        payload = rec["payload"]
+        if self._corrupt_hook is not None:
+            payload = self._corrupt_hook("spill_get", payload)
+        if not self._read_ok(block_hash, payload, rec.get("checksum")):
+            return None
+        return payload
 
     def discard(self, block_hash: str) -> None:
         if block_hash in self._entries:
@@ -872,8 +926,18 @@ class HostSpillStore:
         rec = self._entries.get(block_hash)
         if rec is None:
             return None
-        return {k: np.array(v, copy=True)
-                for k, v in rec["payload"].items()}
+        payload = {k: np.array(v, copy=True)
+                   for k, v in rec["payload"].items()}
+        if self._corrupt_hook is not None:
+            payload = self._corrupt_hook("spill_get", payload)
+        if not self._read_ok(block_hash, payload, rec.get("checksum")):
+            # rot detected on the read: the resident entry is no
+            # longer trustworthy either — discard it (a future local
+            # hit would re-detect anyway; dropping now keeps the
+            # byte accounting honest)
+            self._drop(block_hash)
+            return None
+        return payload
 
     def import_entry(self, block_hash: str,
                      payload: Dict[str, np.ndarray],
@@ -893,12 +957,47 @@ class HostSpillStore:
                 f"{missing} (expected the block's K/V arrays)")
         return self.put(block_hash, payload, tenant=tenant)
 
+    def scrub(self, n: int) -> Tuple[int, int]:
+        """Re-verify up to ``n`` resident entries against their put-time
+        checksums, round-robin from where the last scrub stopped — the
+        background integrity pass (docs/robustness.md): rot in a COLD
+        entry is found while recompute is still cheap, not at the
+        admission that needed it. Corrupt entries are discarded and
+        counted exactly like a read-side detection. Returns
+        ``(entries_verified, corruptions_found)``; (0, 0) with
+        verification off or an empty store."""
+        if not self.verify or n < 1 or not self._entries:
+            return (0, 0)
+        hashes = list(self._entries.keys())
+        start = self._scrub_cursor % len(hashes)
+        scanned = min(int(n), len(hashes))
+        verified = corrupt = 0
+        for j in range(scanned):
+            h = hashes[(start + j) % len(hashes)]
+            rec = self._entries.get(h)
+            if rec is None or rec.get("checksum") is None:
+                continue
+            verified += 1
+            if payload_checksum(rec["payload"]) != rec["checksum"]:
+                self._drop(h)
+                self.corrupt_discards += 1
+                corrupt += 1
+                if self._on_corrupt is not None:
+                    self._on_corrupt("scrub", h)
+        self._scrub_cursor = start + scanned
+        return (verified, corrupt)
+
     def stats(self) -> Dict[str, int]:
         return {
             "blocks": len(self._entries),
             "bytes": int(self.total_bytes),
             "puts": int(self.puts),
             "evictions": int(self.evictions),
+            # the uniform refusal/corruption surface (docs/robustness.md
+            # "Data integrity"): oversize entries never admitted, and
+            # entries dropped on a checksum mismatch
+            "refused": int(self.refused),
+            "corrupt_discards": int(self.corrupt_discards),
         }
 
 

@@ -9,7 +9,7 @@ or ``"train_step"``) and **call index** at that site, so a chaos run is
 exactly as replayable as the bit-deterministic serving/training runs it
 attacks (docs/robustness.md).
 
-Three fault kinds, mirroring the three ways a dispatch actually dies:
+Four fault kinds, mirroring the ways a dispatch (or its data) dies:
 
 - ``"transient"`` — raise :class:`TransientDispatchError` *instead of*
   running the dispatch: the compile-service tunnel dropped, the runtime
@@ -25,10 +25,22 @@ Three fault kinds, mirroring the three ways a dispatch actually dies:
   chosen step. Nothing catches this (that is the point); tests catch it
   at top level and prove recovery from the last snapshot/checkpoint is
   bit-identical to the uninterrupted run.
+- ``"corrupt"`` — silent data corruption (docs/robustness.md, "Data
+  integrity"): the call proceeds, and the caller perturbs the artifact
+  it owns with a SEEDED deterministic byte/value flip
+  (:func:`perturb_payload` / :func:`perturb_json` /
+  :func:`perturb_tokens`, keyed by :meth:`FaultPlan.corrupt_seed`).
+  Fired at the integrity sites — ``"spill_put"`` / ``"spill_get"``
+  (the host spill tier's write/read paths), ``"checkpoint"`` (the
+  periodic failover picture), ``"export"`` / ``"import"`` (migration
+  records, one fire per record) — where checksum verification must
+  catch it, and at ``"decode"``, where it models a flaky chip emitting
+  a wrong token (no checksum can catch compute corruption; the fleet's
+  determinism cross-check does).
 
 The plan fires BEFORE the wrapped call for ``transient``/``crash``
 (the dispatch never launches, so no donated buffer is consumed and the
-caller's retry sees intact state) and AFTER it for ``nan``.
+caller's retry sees intact state) and AFTER it for ``nan``/``corrupt``.
 
 Determinism: exact-index triggers (``at=``, ``every=``) depend only on
 the per-site call count; probabilistic triggers (``prob=``) draw from
@@ -43,7 +55,7 @@ import dataclasses
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-_FAULT_KINDS = ("transient", "nan", "crash")
+_FAULT_KINDS = ("transient", "nan", "crash", "corrupt")
 
 
 class TransientDispatchError(RuntimeError):
@@ -140,6 +152,11 @@ class FaultPlan:
         self._calls: Dict[str, int] = {}
         self._spec_fires = [0] * len(self.specs)
         self.fired: List[Tuple[str, str, int]] = []  # (site, kind, index)
+        # per site: the call index of the MOST RECENT fire() that hit a
+        # "corrupt" spec, reset to None on every call — the one-call
+        # window in which corrupt_seed() hands the caller its
+        # perturbation key
+        self._last_corrupt: Dict[str, Optional[int]] = {}
 
     def calls(self, site: str) -> int:
         """How many times ``site`` has been guarded so far."""
@@ -157,13 +174,17 @@ class FaultPlan:
         """Advance the site's call counter and apply matching rules.
 
         Raises for ``transient``/``crash`` hits; returns True when a
-        ``nan`` rule hit (the caller owns the corruption). Specs are
+        ``nan`` rule hit (the caller owns the corruption). A
+        ``corrupt`` hit does NOT raise the flag — it arms
+        :meth:`corrupt_seed` for this one call, and the caller applies
+        the seeded perturbation to the artifact it owns. Specs are
         scanned in declaration order and a raising hit stops the scan,
         so a later probabilistic spec's RNG draw is skipped on that
         call — keep at most one probabilistic spec per site when you
         need draw-for-draw reproducibility across plan edits."""
         i = self._calls.get(site, 0)
         self._calls[site] = i + 1
+        self._last_corrupt[site] = None
         nan_hit = False
         for s_idx, spec in enumerate(self.specs):
             if spec.site != site:
@@ -186,8 +207,28 @@ class FaultPlan:
             if spec.kind == "transient":
                 raise TransientDispatchError(
                     f"injected transient failure at site {site!r} call {i}")
+            if spec.kind == "corrupt":
+                # corrupt is its own silent channel, NOT a nan hit:
+                # the caller consults corrupt_seed() and applies the
+                # seeded perturbation it owns — returning True here
+                # would make an unvalidated consumer (the train loop's
+                # nan watchdog, wrap()'s NaN-fill) treat corruption as
+                # a nan fault
+                self._last_corrupt[site] = i
+                continue
             nan_hit = True
         return nan_hit
+
+    def corrupt_seed(self, site: str) -> Optional[int]:
+        """The deterministic perturbation seed for the MOST RECENT
+        :meth:`fire` at ``site`` — ``None`` unless that call hit a
+        ``"corrupt"`` spec. Derived from (plan seed, site, call index),
+        so a given chaos plan corrupts the same artifact the same way
+        on every run (:func:`corruption_seed`)."""
+        i = self._last_corrupt.get(site)
+        if i is None:
+            return None
+        return corruption_seed(self.seed, site, i)
 
     def wrap(self, site: str, fn, corrupt=None):
         """``fn`` guarded by this plan at ``site``. ``corrupt`` maps the
@@ -238,6 +279,94 @@ def guarded_call(fn, *args, plan: Optional[FaultPlan] = None,
         except TRANSIENT_ERRORS as e:
             last = e
     raise DispatchFailedError(site, retries + 1, last)
+
+
+def corruption_seed(plan_seed: int, site: str, index: int) -> int:
+    """The perturbation key of one ``"corrupt"`` fire: a pure function
+    of (plan seed, site, per-site call index), so corruption is as
+    replayable as the schedule it attacks."""
+    import hashlib
+
+    digest = hashlib.sha256(
+        f"{int(plan_seed)}:{site}:{int(index)}".encode("ascii")).digest()
+    return int.from_bytes(digest[:4], "big")
+
+
+def perturb_payload(payload, seed: int):
+    """Deterministically flip ONE byte of one array in a numpy payload
+    dict (the spill/transport corruption model: a bit flip in host RAM
+    after the checksum was taken). Returns a NEW dict — only the
+    touched array is copied; non-array values pass through."""
+    import numpy as np
+
+    keys = sorted(k for k, v in payload.items()
+                  if isinstance(v, np.ndarray) and v.nbytes > 0)
+    out = dict(payload)
+    if not keys:
+        return out
+    rng = np.random.RandomState(seed & 0xFFFFFFFF)
+    k = keys[rng.randint(len(keys))]
+    a = np.array(payload[k], copy=True)
+    flat = a.view(np.uint8).reshape(-1)
+    flat[rng.randint(flat.size)] ^= np.uint8(1 + rng.randint(255))
+    out[k] = a
+    return out
+
+
+def perturb_json(obj, seed: int):
+    """Deterministically perturb ONE numeric leaf of a JSON-able tree
+    (the record/checkpoint corruption model). Deep-copies via the JSON
+    round trip the artifact would ride anyway; bool leaves are left
+    alone (they encode as ``true``/``false``, not numbers). A tree
+    with no numeric leaf comes back unchanged."""
+    import json
+    import random
+
+    out = json.loads(json.dumps(obj))
+    leaves = []
+
+    def walk(node, container, key):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], node, k)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, node, i)
+        elif isinstance(node, (int, float)) and not isinstance(node, bool):
+            leaves.append((container, key))
+
+    walk(out, None, None)
+    if leaves:
+        rng = random.Random(seed)
+        container, key = leaves[rng.randrange(len(leaves))]
+        delta = 1 + rng.randrange(997)
+        container[key] = container[key] + delta
+    return out
+
+
+def perturb_tokens(tokens, counts, vocab_size: int, seed: int):
+    """Deterministically corrupt ONE emitted token of a drained decode
+    batch — the silent-data-corruption model: a flaky chip computed a
+    wrong (but in-vocabulary) token id. ``tokens`` is the fetched
+    ``[B, K]`` int array, ``counts`` the per-lane valid-token counts;
+    the perturbed copy is returned (unchanged when no lane emitted
+    anything). The replacement differs from the original by
+    construction and stays in ``[0, vocab_size)`` — nothing downstream
+    can tell it from a legitimately-sampled token, which is the
+    point."""
+    import numpy as np
+
+    tokens = np.array(tokens, copy=True)
+    lanes = [i for i in range(tokens.shape[0]) if counts[i] > 0]
+    if not lanes or vocab_size < 2:
+        return tokens
+    rng = np.random.RandomState(seed & 0xFFFFFFFF)
+    lane = lanes[rng.randint(len(lanes))]
+    pos = rng.randint(int(counts[lane]))
+    old = int(tokens[lane, pos])
+    tokens[lane, pos] = (old + 1 + rng.randint(vocab_size - 1)) \
+        % vocab_size
+    return tokens
 
 
 def nan_corrupt(tree):

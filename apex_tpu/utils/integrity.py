@@ -1,0 +1,152 @@
+"""End-to-end data integrity for host-side artifacts
+(docs/robustness.md, "Data integrity").
+
+Every host artifact the serving stack moves between processes,
+replicas, or memory tiers — snapshot/checkpoint records, spilled KV
+blocks, migration records, cross-replica KV payloads — is consumed by
+machinery that TRUSTS its bytes. A bit flip in host RAM, a truncated
+copy, or a buggy transport therefore does not crash: it silently
+serves wrong tokens, re-prefills a corrupted history, or attends
+against another request's KV. This module makes that trust explicit
+and checkable:
+
+- :func:`payload_checksum` — SHA-256 over the canonical bytes of a
+  numpy-array payload dict (key names, dtypes, shapes, raw bytes, in
+  sorted key order). The checksum of a spilled/transported KV block.
+- :func:`record_checksum` — SHA-256 over the canonical JSON encoding
+  (sorted keys, no whitespace) of a JSON-able record, EXCLUDING the
+  ``"checksum"`` field itself. Stable across a ``json.dumps`` →
+  ``json.loads`` round trip (the snapshot wire format), so a record
+  sealed in one process verifies in another.
+- :func:`seal_record` / :func:`verify_record` — attach / check the
+  embedded checksum. A record WITHOUT a checksum verifies trivially:
+  checksum-less legacy artifacts stay loadable (the PR 9 torn-marker
+  lesson — new metadata must never orphan old artifacts), and the
+  detection guarantee is stated honestly as covering sealed artifacts
+  only.
+- :class:`IntegrityError` — the typed verification failure, carrying
+  the consumption site. NEVER caught-and-ignored: every consumer
+  routes it through an existing degradation path (a corrupt spill
+  entry is a cache miss, a corrupt checkpoint falls back to fresh
+  re-injection, a corrupt migration import is refused so the source
+  keeps the request) and counts the detection.
+
+Checksums are detection, not correction: the recovery story is the
+redundancy the engine already has — recompute for cache tiers, the
+router's own request copies for failover, the source replica for
+refused migrations. See docs/robustness.md for the threat model and
+the per-artifact routing table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+# the embedded-checksum field name shared by every sealed record
+CHECKSUM_KEY = "checksum"
+
+
+class IntegrityError(RuntimeError):
+    """A checksummed artifact failed verification at consumption.
+
+    Carries the consumption ``site`` (``"spill_get"``, ``"restore"``,
+    ``"import"``, ``"checkpoint"``, ...) so counters and the flight
+    recorder can attribute the detection. Raised only where refusal is
+    the correct degradation (migration imports, operator restores);
+    cache-tier consumers detect-and-discard instead of raising."""
+
+    def __init__(self, site: str, detail: str):
+        super().__init__(f"integrity check failed at {site!r}: {detail}")
+        self.site = site
+        self.detail = detail
+
+
+def payload_checksum(payload: Mapping[str, object]) -> str:
+    """SHA-256 over a payload dict's canonical bytes.
+
+    Only numpy-array values participate (string/None metadata keys —
+    e.g. an embedded ``"checksum"`` riding a transported payload — are
+    skipped), each contributing its key name, dtype, shape, and raw
+    C-order bytes, in sorted key order: two payloads checksum equal
+    iff their array contents are equal."""
+    h = hashlib.sha256()
+    for key in sorted(payload):
+        a = payload[key]
+        if not isinstance(a, np.ndarray):
+            continue
+        a = np.ascontiguousarray(a)
+        h.update(key.encode("utf-8"))
+        h.update(str(a.dtype).encode("ascii"))
+        h.update(repr(a.shape).encode("ascii"))
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _canonical_json(record: Mapping) -> bytes:
+    body = {k: v for k, v in record.items() if k != CHECKSUM_KEY}
+    # normalize through one JSON round trip FIRST: the wire format
+    # stringifies non-string dict keys (and turns tuples into lists),
+    # which changes sort_keys ordering — a record must checksum
+    # identically before and after riding a file/socket, or every
+    # sealed artifact would read as corrupt on arrival
+    body = json.loads(json.dumps(body))
+    return json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def record_checksum(record: Mapping) -> str:
+    """SHA-256 over a JSON-able record's canonical encoding (sorted
+    keys, compact separators), excluding the embedded checksum field.
+    ``json`` round-trips finite floats exactly (``repr`` encoding), so
+    the checksum survives the snapshot's serialize → file → parse
+    path bit-for-bit."""
+    return hashlib.sha256(_canonical_json(record)).hexdigest()
+
+
+def seal_record(record: Dict) -> Dict:
+    """Embed the record's checksum under :data:`CHECKSUM_KEY` (in
+    place; also returned). Seal LAST — any mutation after sealing is
+    indistinguishable from corruption, which is the point."""
+    record[CHECKSUM_KEY] = record_checksum(record)
+    return record
+
+
+def verify_record(record: Mapping, site: str) -> bool:
+    """Check a record against its embedded checksum.
+
+    Returns True when the record verifies, False when it carries no
+    checksum (legacy artifact — acceptable by policy, distinguishable
+    by the caller via :func:`is_sealed`). Raises
+    :class:`IntegrityError` on a mismatch."""
+    expect = record.get(CHECKSUM_KEY)
+    if expect is None:
+        return False
+    actual = record_checksum(record)
+    if actual != expect:
+        raise IntegrityError(
+            site, f"record checksum {actual[:16]}... != sealed "
+                  f"{str(expect)[:16]}...")
+    return True
+
+
+def is_sealed(record: Mapping) -> bool:
+    return record.get(CHECKSUM_KEY) is not None
+
+
+def verify_payload(payload: Mapping[str, object],
+                   expect: Optional[str], site: str) -> bool:
+    """Check a payload dict against a detached checksum (None =
+    legacy/unchecksummed, verifies trivially as False). Raises
+    :class:`IntegrityError` on a mismatch."""
+    if expect is None:
+        return False
+    actual = payload_checksum(payload)
+    if actual != expect:
+        raise IntegrityError(
+            site, f"payload checksum {actual[:16]}... != recorded "
+                  f"{str(expect)[:16]}...")
+    return True

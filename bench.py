@@ -2152,6 +2152,246 @@ def bench_serving_fleet(fast=False):
     }
 
 
+def bench_serving_integrity(fast=False):
+    """Data-integrity chaos arm (round 13, docs/robustness.md "Data
+    integrity"): the end-to-end corruption story, certified where it
+    matters — seeded "corrupt" faults at EVERY checksum point, and a
+    silently-wrong-compute replica caught by the fleet's determinism
+    cross-check.
+
+    Three phases: (0) identity — integrity machinery fully disabled
+    (``verify_artifacts=False``, no scrub, no cross-check) must be
+    BIT-IDENTICAL to checksums-on, bare engine AND 1-replica fleet
+    (outputs, statuses, the full stats dict): verification is pure
+    detection, and enabling checksums alone changes no served token.
+    (1) artifact chaos — an engine whose spill tier rots under a
+    seeded plan must serve the identical tokens by recompute, and a
+    2-replica fleet under corrupt plans covering
+    spill_put/spill_get/checkpoint/export/import, with a migration and
+    a hard kill mid-run, must finish with ZERO lost accepted requests,
+    every accepted uid terminal exactly once, and every fired
+    corruption caught (refused imports / corrupt checkpoints / spill
+    discards all counted). (2) SDC — a 3-replica fleet with a
+    ``"corrupt"`` decode fault on replica 0 and the cross-check on
+    must detect the diverging replica, retire it, and lose nothing;
+    DETECTION LATENCY (router ticks from the first corrupt token to
+    the suspect verdict) is the reported metric. ``vs_baseline`` is
+    SDC-phase goodput over the clean phase-0 fleet goodput (the price
+    of serving through a corrupting replica + its retirement).
+    ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  InferenceEngine, Request,
+                                  SamplingParams)
+    from apex_tpu.utils.faults import FaultPlan, FaultSpec
+
+    on_tpu = _backend_with_cpu_fallback() == "tpu" and not fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small(dropout=0.0, remat=False,
+                                   dtype=jnp.bfloat16)
+        ekw = dict(max_batch=8, block_size=32, num_blocks=96,
+                   max_prefill_len=128, max_seq_len=384,
+                   kv_dtype=jnp.bfloat16, enable_prefix_caching=True,
+                   spill_max_bytes=64 << 20,
+                   snapshot_interval_ticks=2, seed=13)
+        n_req, new_tokens = 24, 16
+    else:
+        cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+        ekw = dict(max_batch=2, block_size=4, num_blocks=10,
+                   max_prefill_len=8, max_seq_len=32,
+                   enable_prefix_caching=True, spill_max_bytes=1 << 20,
+                   snapshot_interval_ticks=2, seed=13)
+        n_req, new_tokens = (8 if fast else 12), 4
+    model = GPTLMHeadModel(cfg)
+    init_rng = np.random.RandomState(1905)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(init_rng.randint(0, cfg.vocab_size, (1, 8))))
+    # FIXED seeds: every phase asserts — the trace must not drift
+    rng = np.random.RandomState(1906)
+    prompts = [list(rng.randint(1, cfg.vocab_size, 8))
+               for _ in range(6)]
+
+    def requests(prefix):
+        out = []
+        for k in range(n_req):
+            samp = (SamplingParams(temperature=1.0, top_k=20)
+                    if k % 2 else SamplingParams())
+            out.append(Request(f"{prefix}{k}",
+                               list(prompts[k % len(prompts)]),
+                               max_new_tokens=new_tokens,
+                               sampling=samp))
+        return out
+
+    def resdict(res):
+        return {u: (tuple(r.tokens), r.status) for u, r in res.items()}
+
+    # -- phase 0: integrity-off bit-identity (constant clock so the
+    # full stats dict compares) --
+    def engine_run(verify):
+        eng = InferenceEngine(
+            model, params, EngineConfig(**ekw, verify_artifacts=verify),
+            clock=lambda: 0.0)
+        for r in requests("i"):
+            eng.add_request(r)
+        return resdict(eng.run(return_status=True)), eng.stats()
+
+    off_res, off_stats = engine_run(False)
+    on_res, on_stats = engine_run(True)
+    assert off_res == on_res, "checksums changed served tokens"
+    assert off_stats == on_stats, "checksums changed schedule counters"
+
+    def fleet_run(verify):
+        t0 = time.perf_counter()
+        fl = FleetRouter(model, params,
+                         EngineConfig(**ekw, verify_artifacts=verify),
+                         FleetConfig(num_replicas=1),
+                         clock=lambda: 0.0)
+        for r in requests("f"):
+            fl.add_request(r)
+        res = resdict(fl.run(return_status=True))
+        return res, fl.replicas[0].engine.stats(), \
+            time.perf_counter() - t0
+
+    f_off, fs_off, _ = fleet_run(False)
+    f_on, fs_on, wall_clean = fleet_run(True)
+    assert f_off == f_on and fs_off == fs_on, \
+        "1-replica fleet diverged across verify_artifacts"
+    identity_ok = True
+    clean_tokens = sum(len(t) for t, _ in f_on.values())
+    clean_good = clean_tokens / max(wall_clean, 1e-9)
+
+    # -- phase 1a: spill rot served by recompute, token-identically --
+    def spill_serve(plan):
+        eng = InferenceEngine(model, params, EngineConfig(**ekw),
+                              faults=plan, clock=lambda: 0.0)
+        outs = {}
+        for wave in range(2):
+            for k, p in enumerate(prompts):
+                eng.add_request(Request(f"s{wave}.{k}", list(p),
+                                        max_new_tokens=new_tokens))
+                outs.update(eng.run())
+        return outs, eng.stats()
+
+    clean_spill, clean_sst = spill_serve(None)
+    rot_plan = FaultPlan([FaultSpec(site="spill_put", kind="corrupt",
+                                    every=2)], seed=1907)
+    rot_spill, rot_sst = spill_serve(rot_plan)
+    assert rot_spill == clean_spill, "corrupt spill changed tokens"
+    spill_discards = int(rot_sst["num_spill_corrupt_discards"])
+    assert spill_discards > 0, "the spill rot never fired"
+
+    # -- phase 1b: fleet-wide artifact chaos + migrate + kill --
+    def chaos_plan(seed):
+        return FaultPlan([
+            FaultSpec(site="spill_put", kind="corrupt", every=3),
+            FaultSpec(site="spill_get", kind="corrupt", every=4),
+            FaultSpec(site="checkpoint", kind="corrupt", every=2),
+            FaultSpec(site="export", kind="corrupt", every=2),
+            FaultSpec(site="import", kind="corrupt", every=2),
+        ], seed=seed)
+
+    fl = FleetRouter(model, params,
+                     EngineConfig(**ekw, scrub_interval_ticks=3),
+                     FleetConfig(num_replicas=2, respawn=True),
+                     faults=[chaos_plan(1908), chaos_plan(1909)])
+    accepted = []
+    for r in requests("a"):
+        if fl.try_add(r):
+            accepted.append(r.uid)
+    for _ in range(3):
+        fl.step()
+    owners = fl.owners()
+    if owners:
+        u = sorted(owners)[0]
+        fl.migrate([u], owners[u])
+    fl.step()
+    fl.kill_replica(0)
+    chaos_res = fl.run(return_status=True)
+    chaos_stats = fl.stats()
+    missing = set(accepted) - set(chaos_res)
+    assert not missing, f"lost accepted requests: {sorted(missing)}"
+    assert chaos_stats["num_lost_requests"] == 0
+    chaos_detections = (
+        chaos_stats["num_refused_imports"]
+        + chaos_stats["num_corrupt_checkpoints"]
+        + sum(rep.engine.stats()["num_corruptions_detected"]
+              for rep in fl.replicas
+              if rep.alive and rep.engine is not None))
+    assert chaos_detections > 0, "artifact chaos never detected"
+
+    # -- phase 2: the SDC cross-check --
+    sdc_plan = FaultPlan([FaultSpec(site="decode", kind="corrupt",
+                                    every=3)], seed=1910)
+    fl = FleetRouter(model, params, EngineConfig(**ekw),
+                     FleetConfig(num_replicas=3,
+                                 sdc_check_interval_ticks=2),
+                     faults=[sdc_plan, None, None])
+    sdc_accepted = []
+    for r in requests("d"):
+        if fl.try_add(r):
+            sdc_accepted.append(r.uid)
+    first_corrupt_tick = suspect_tick = None
+    tick = 0
+    t0 = time.perf_counter()
+    while fl.has_work:
+        fl.step()
+        tick += 1
+        if first_corrupt_tick is None and any(
+                kind == "corrupt" for _, kind, _ in sdc_plan.fired):
+            first_corrupt_tick = tick
+        if (suspect_tick is None
+                and fl.stats()["num_sdc_suspects"] >= 1):
+            suspect_tick = tick
+    wall_sdc = time.perf_counter() - t0
+    sdc_res = fl.run(return_status=True)
+    sdc_stats = fl.stats()
+    assert first_corrupt_tick is not None, "the SDC fault never fired"
+    assert suspect_tick is not None, \
+        "the cross-check never caught the corrupt replica"
+    assert not fl.replicas[0].alive
+    assert sdc_stats["num_lost_requests"] == 0
+    assert set(sdc_res) == set(sdc_accepted), "terminals not exactly-once"
+    detection_latency = suspect_tick - first_corrupt_tick
+    sdc_tokens = sum(len(r.tokens) for r in sdc_res.values())
+    sdc_good = sdc_tokens / max(wall_sdc, 1e-9)
+
+    print(f"# serving integrity: identity OK | spill rot "
+          f"{spill_discards} discards served token-identically | "
+          f"artifact chaos {chaos_detections} detections, lost "
+          f"{chaos_stats['num_lost_requests']} | SDC caught in "
+          f"{detection_latency} ticks (corrupt@{first_corrupt_tick} -> "
+          f"suspect@{suspect_tick}), checks "
+          f"{sdc_stats['num_sdc_checks']}, goodput {sdc_good:.1f} "
+          f"tok/s vs clean {clean_good:.1f}", file=sys.stderr)
+    return {
+        "metric": ("serving_gpt2s_integrity_sdc_detection_latency_ticks"
+                   if on_tpu else
+                   "serving_tiny_integrity_sdc_detection_latency_ticks"),
+        "value": float(detection_latency),
+        "unit": "ticks",
+        # the cost of serving through a corrupting replica + its
+        # retirement, relative to the clean 1-replica fleet
+        "vs_baseline": round(sdc_good / max(clean_good, 1e-9), 4),
+        "identity_ok": identity_ok,
+        "spill_corrupt_discards": spill_discards,
+        "spill_served_token_identical": True,
+        "chaos_detections": int(chaos_detections),
+        "chaos_refused_imports":
+            int(chaos_stats["num_refused_imports"]),
+        "chaos_corrupt_checkpoints":
+            int(chaos_stats["num_corrupt_checkpoints"]),
+        "chaos_zero_lost": True,
+        "sdc_checks": int(sdc_stats["num_sdc_checks"]),
+        "sdc_suspects": int(sdc_stats["num_sdc_suspects"]),
+        "sdc_first_corrupt_tick": int(first_corrupt_tick),
+        "sdc_suspect_tick": int(suspect_tick),
+        "sdc_zero_lost": True,
+        "sdc_exactly_once": True,
+        "sdc_goodput_tok_per_sec": round(sdc_good, 3),
+    }
+
+
 def bench_train_step(fast=False):
     """Fused train step (apex_tpu.train): the whole global optimizer
     step — amp O2 scaled forward/backward, ``accum_steps`` scanned
@@ -2429,6 +2669,8 @@ def main():
              lambda: bench_serving_kv_memory(fast=True)),
             ("bench_serving_fleet",
              lambda: bench_serving_fleet(fast=True)),
+            ("bench_serving_integrity",
+             lambda: bench_serving_integrity(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -2494,8 +2736,8 @@ def main():
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
                  bench_serving_multitenant, bench_serving_kv_memory,
-                 bench_serving_fleet, bench_train_step,
-                 bench_obs_pipeline]
+                 bench_serving_fleet, bench_serving_integrity,
+                 bench_train_step, bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

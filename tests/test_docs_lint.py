@@ -78,6 +78,43 @@ def test_lint_detects_phantom_fleet_names(monkeypatch):
         assert p in missing
 
 
+def test_lint_detects_phantom_integrity_names(monkeypatch):
+    """The integrity surface is checked against docs/robustness.md
+    specifically: a phantom integrity knob/counter must be flagged."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+    phantom = ("integrity surface", "num_phantom_integrity_counter")
+
+    def with_phantom():
+        return orig() + [phantom]
+
+    monkeypatch.setattr(mod, "collect_names", with_phantom)
+    missing = mod.main()
+    assert phantom in missing
+
+
+def test_integrity_names_are_live_surfaces():
+    """INTEGRITY_NAMES cross-checks itself against the live config and
+    stats surfaces: naming a nonexistent knob/key raises, so a rename
+    cannot silently unpin the robustness.md routing."""
+    mod = _load_check_docs()
+    names = mod.collect_names()
+    integ = {n for k, n in names if k == "integrity surface"}
+    assert integ == set(mod.INTEGRITY_NAMES)
+    live = {n for k, n in names if k != "integrity surface"}
+    assert integ <= live
+
+
+def test_integrity_names_are_checked_against_robustness_doc():
+    """The integrity kinds map to docs/robustness.md alone — a name
+    present only in fleet.md must not satisfy them (the fleet knob
+    sdc_check_interval_ticks is deliberately documented in BOTH)."""
+    mod = _load_check_docs()
+    rob_text = mod._docs_text(mod.ROBUSTNESS_DOCS)
+    for name in mod.INTEGRITY_NAMES:
+        assert name in rob_text, name
+
+
 def test_fleet_names_are_checked_against_their_doc():
     """A name present only in docs/fleet.md must NOT satisfy a
     serving-kind check and vice versa — the fleet kinds map to their

@@ -898,3 +898,126 @@ def test_fuzz_add_abort_kill_migrate_exactly_once(tiny_gpt):
     for rep in fleet.replicas:
         if rep.alive and rep.engine is not None:
             rep.engine.check_allocator_integrity()
+
+
+def test_fuzz_with_corruption_faults_zero_undetected(tiny_gpt):
+    """The 60-op fuzz under seeded CORRUPTION plans covering every
+    checksum point (spill writes/reads, checkpoints, migration records
+    both directions), with independent test-side oracles wrapped
+    around every consumption path: the zero-lost gauge reads 0 after
+    every op, and ZERO corrupted artifacts are consumed undetected —
+    every spill payload an engine admits hashes to the clean bytes its
+    put recorded, and every migration record an import ACCEPTS matches
+    the record the caller sent (a corruption either refused/discarded
+    — caught — or never consumed)."""
+    from apex_tpu.utils.integrity import payload_checksum
+
+    rng = np.random.RandomState(4321)
+    model, params = tiny_gpt
+    plans = [FaultPlan([
+        FaultSpec(site="spill_put", kind="corrupt", every=3),
+        FaultSpec(site="spill_get", kind="corrupt", every=4),
+        FaultSpec(site="checkpoint", kind="corrupt", every=2),
+        FaultSpec(site="export", kind="corrupt", every=2),
+        FaultSpec(site="import", kind="corrupt", every=3),
+    ], seed=100 + i) for i in range(3)]
+    ekw = dict(ENGINE_KW, num_blocks=12, spill_max_bytes=1 << 20,
+               snapshot_interval_ticks=2, scrub_interval_ticks=3)
+    fleet = FleetRouter(
+        model, params, EngineConfig(**ekw),
+        FleetConfig(num_replicas=3, respawn=True),
+        faults=plans)
+    truth: dict = {}    # chain hash -> clean payload checksum
+
+    def wrap_store(store):
+        orig_put, orig_pop = store.put, store.pop
+
+        def put(h, payload, tenant="default"):
+            truth[h] = payload_checksum(payload)  # the TRUE bytes
+            return orig_put(h, payload, tenant=tenant)
+
+        def pop(h):
+            out = orig_pop(h)
+            if out is not None:
+                assert payload_checksum(out) == truth[h], (
+                    f"UNDETECTED corrupt spill admission for {h}")
+            return out
+
+        store.put, store.pop = put, pop
+
+    def wrap_import(eng):
+        orig = eng.import_requests
+
+        def import_requests(records):
+            want = {r["uid"]: ([int(t) for t in r["prompt"]],
+                               [int(t) for t in r.get("generated", ())])
+                    for r in records}
+            n = orig(records)
+            for entry in eng.waiting:
+                got = want.get(entry.request.uid)
+                if got is not None:
+                    assert ([int(t) for t in entry.request.prompt],
+                            [int(t) for t in entry.generated]) == got, (
+                        "UNDETECTED corrupt import accepted")
+            return n
+
+        eng.import_requests = import_requests
+
+    def instrument(rep):
+        if rep.engine is None:
+            return
+        if rep.engine.spill is not None:
+            wrap_store(rep.engine.spill)
+        wrap_import(rep.engine)
+
+    for rep in fleet.replicas:
+        instrument(rep)
+    shared = list(rng.randint(1, 50, 8))
+    accepted, uid, kills = [], 0, 0
+    for op_i in range(60):
+        op = rng.rand()
+        if op < 0.45:
+            prompt = (list(shared) if rng.rand() < 0.5
+                      else list(rng.randint(1, 50, rng.randint(3, 10))))
+            samp = (SamplingParams(temperature=1.0, top_k=10)
+                    if rng.rand() < 0.5 else SamplingParams())
+            req = Request(f"z{uid}", prompt,
+                          max_new_tokens=int(rng.randint(1, 6)),
+                          sampling=samp)
+            uid += 1
+            if fleet.try_add(req):
+                accepted.append(req.uid)
+        elif op < 0.55 and accepted:
+            fleet.abort(accepted[int(rng.randint(len(accepted)))])
+        elif op < 0.62 and kills < 3:
+            alive = [i for i, rep in enumerate(fleet.replicas)
+                     if rep.alive]
+            if len(alive) > 1:
+                victim = alive[int(rng.randint(len(alive)))]
+                fleet.kill_replica(victim)
+                instrument(fleet.replicas[victim])   # the respawn
+                kills += 1
+        elif op < 0.72:
+            owners = fleet.owners()
+            if owners:
+                u = list(owners)[int(rng.randint(len(owners)))]
+                fleet.migrate([u], owners[u])
+        else:
+            fleet.step()
+        assert fleet.stats()["num_lost_requests"] == 0
+    res = fleet.run(return_status=True)
+    assert kills > 0
+    assert set(res) >= set(accepted)
+    stats = fleet.stats()
+    assert stats["num_lost_requests"] == 0
+    # the chaos genuinely fired AND was genuinely caught somewhere:
+    # refused imports, corrupt checkpoints, or spill discards
+    detections = (
+        stats["num_refused_imports"] + stats["num_corrupt_checkpoints"]
+        + sum(rep.engine.stats()["num_corruptions_detected"]
+              for rep in fleet.replicas
+              if rep.alive and rep.engine is not None))
+    assert detections > 0, "corruption plan never detected anything"
+    for rep in fleet.replicas:
+        if rep.alive and rep.engine is not None:
+            rep.engine.check_allocator_integrity()

@@ -516,9 +516,13 @@ def test_gate_ewmas_ride_snapshot_restore(tiny_gpt):
     s = restored.stats()
     assert s["ewma_prefill_dispatch_s"] == pytest.approx(0.75)
     assert s["ewma_decode_dispatch_s"] == pytest.approx(0.25)
-    # a pre-overload snapshot without the keys: gate stays open
+    # a pre-overload snapshot without the keys: gate stays open. A
+    # genuinely older snapshot predates the embedded checksum too —
+    # drop the seal, or the (correct) integrity check reads this
+    # hand-edited record as corruption
     del snap["overload"]["ewma_prefill_s"]
     del snap["overload"]["ewma_decode_s"]
+    del snap["checksum"]
     older = _mk(tiny_gpt)
     older.restore(snap)
     assert older._ewma_prefill_s is None

@@ -545,6 +545,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_multitenant_victim_goodput_tok_per_sec",
         "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
         "serving_tiny_fleet_kill_goodput_tok_per_sec",
+        "serving_tiny_integrity_sdc_detection_latency_ticks",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -621,6 +622,28 @@ def test_bench_smoke_mode_every_section_rc0():
     assert flr["status_counts"].get("finished", 0) > 0, flr
     assert flr["allocator_integrity_ok"] is True, flr
     assert math.isfinite(flr["vs_baseline"]) and flr["value"] > 0, flr
+    # the data-integrity arm (docs/robustness.md "Data integrity")
+    # must prove the whole detection story: integrity-off bit-identity
+    # held, spill rot was detected AND served token-identically by
+    # recompute, the fleet-wide artifact chaos lost nothing while
+    # catching every fired corruption, and the SDC-faulted replica was
+    # caught by the cross-check with a real (finite, nonnegative)
+    # detection latency — a silently-skipped phase would be a quiet
+    # integrity lie
+    it = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_integrity_sdc_detection_latency_ticks"][0]
+    assert it["identity_ok"] is True, it
+    assert it["spill_corrupt_discards"] > 0, it
+    assert it["spill_served_token_identical"] is True, it
+    assert it["chaos_detections"] > 0, it
+    assert it["chaos_zero_lost"] is True, it
+    assert it["sdc_suspects"] >= 1, it
+    assert it["sdc_checks"] >= 1, it
+    assert it["sdc_zero_lost"] is True and it["sdc_exactly_once"] is True
+    assert math.isfinite(it["value"]) and it["value"] >= 0, it
+    assert it["sdc_suspect_tick"] >= it["sdc_first_corrupt_tick"], it
+    assert math.isfinite(it["vs_baseline"]) and it["vs_baseline"] > 0
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -638,8 +661,8 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_serving_kv_memory",
-        "bench_serving_fleet", "bench_train_step",
-        "bench_obs_pipeline",
+        "bench_serving_fleet", "bench_serving_integrity",
+        "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

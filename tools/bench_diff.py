@@ -1,0 +1,147 @@
+"""Offline comparer of two bench artifacts (``BENCH_*.json``).
+
+The bench record has carried per-section ``{"section", "status",
+"wall_time_s"}`` exit records since PR 6 (the BENCH_r01/r05 lesson: a
+dead section must be a visible "failed" entry, not an absence) — but
+nothing CONSUMED them: a round whose section quietly vanished from the
+artifact still read as a clean round to a human eyeballing the metric
+lines. This tool closes that loop, stdlib-only so it runs anywhere the
+artifacts land::
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+
+For each section: status transition (``ok -> failed`` and a section
+PRESENT in the old artifact but MISSING from the new one both fail the
+diff, rc != 0 — a disappeared section is the r01/r05 failure mode
+itself). For each metric: value/ratio delta and the ``vs_baseline``
+movement. New sections/metrics are reported as additions, never
+failures.
+
+Accepted inputs, per file: the driver's wrapper JSON (``{"rc", "tail",
+"parsed", ...}`` — records are parsed out of the ``tail`` text), or a
+raw text/JSON-lines file of bench stdout. Unparseable lines (tail
+truncation) are skipped.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Tuple
+
+
+def parse_artifact(path: str) -> Dict[str, Dict]:
+    """``{"metrics": {name: record}, "sections": {name: record},
+    "rc": int | None}`` from one artifact file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        rc = doc.get("rc")
+        lines = str(doc.get("tail") or "").splitlines()
+        if isinstance(doc.get("parsed"), dict):
+            lines.append(json.dumps(doc["parsed"]))
+    elif isinstance(doc, list):
+        lines = [json.dumps(r) for r in doc]
+    else:
+        lines = text.splitlines()
+    metrics: Dict[str, Dict] = {}
+    sections: Dict[str, Dict] = {}
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue    # truncated tail / non-record JSON-ish noise
+        if not isinstance(rec, dict):
+            continue
+        if "metric" in rec:
+            metrics[str(rec["metric"])] = rec
+        elif "section" in rec:
+            sections[str(rec["section"])] = rec
+    return {"metrics": metrics, "sections": sections, "rc": rc}
+
+
+def _fmt_delta(old, new) -> str:
+    try:
+        o, n = float(old), float(new)
+    except (TypeError, ValueError):
+        return f"{old!r} -> {new!r}"
+    ratio = (n / o) if o else float("inf")
+    return f"{o:g} -> {n:g} ({ratio:.3f}x)"
+
+
+def diff(old: Dict[str, Dict], new: Dict[str, Dict]
+         ) -> Tuple[int, list]:
+    """Compare two parsed artifacts. Returns ``(rc, lines)`` — rc 1
+    when a section disappeared or regressed ok -> failed."""
+    lines = []
+    rc = 0
+    lines.append(f"rc: {old['rc']} -> {new['rc']}")
+    o_sec, n_sec = old["sections"], new["sections"]
+    for name in sorted(set(o_sec) | set(n_sec)):
+        if name not in n_sec:
+            lines.append(f"SECTION DISAPPEARED: {name} (was "
+                         f"{o_sec[name].get('status')!r}) — the "
+                         f"r01/r05 failure mode")
+            rc = 1
+            continue
+        if name not in o_sec:
+            lines.append(f"section added: {name} "
+                         f"({n_sec[name].get('status')!r})")
+            continue
+        so = o_sec[name].get("status")
+        sn = n_sec[name].get("status")
+        if so == sn:
+            lines.append(f"section {name}: {sn!r} (unchanged, "
+                         f"{_fmt_delta(o_sec[name].get('wall_time_s'), n_sec[name].get('wall_time_s'))} wall)")
+        else:
+            lines.append(f"SECTION STATUS: {name}: {so!r} -> {sn!r}")
+            if sn != "ok":
+                rc = 1
+    o_met, n_met = old["metrics"], new["metrics"]
+    for name in sorted(set(o_met) | set(n_met)):
+        if name not in n_met:
+            # a metric can legitimately move between rounds (renames,
+            # TPU-only rows on a CPU round) — report, don't fail; the
+            # SECTION records above are the liveness contract
+            lines.append(f"metric gone: {name} "
+                         f"(was {o_met[name].get('value')})")
+            continue
+        if name not in o_met:
+            lines.append(f"metric added: {name} = "
+                         f"{n_met[name].get('value')}")
+            continue
+        o, n = o_met[name], n_met[name]
+        lines.append(
+            f"metric {name}: {_fmt_delta(o.get('value'), n.get('value'))}"
+            f" [{n.get('unit', '?')}], vs_baseline "
+            f"{_fmt_delta(o.get('vs_baseline'), n.get('vs_baseline'))}")
+    if not (o_sec or n_sec):
+        lines.append("note: neither artifact carries section records "
+                     "(pre-PR-6 rounds) — liveness not checkable")
+    return rc, lines
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: python tools/bench_diff.py <OLD.json> <NEW.json>",
+              file=sys.stderr)
+        return 2
+    rc, lines = diff(parse_artifact(argv[0]), parse_artifact(argv[1]))
+    print(f"== bench diff: {argv[0]} -> {argv[1]} ==")
+    for line in lines:
+        print(line)
+    print(f"== verdict: {'FAIL' if rc else 'ok'} ==")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

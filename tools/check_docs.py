@@ -29,10 +29,27 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVING_DOCS = ("docs/serving.md", "docs/robustness.md")
 OBS_DOCS = ("docs/observability.md",)
 FLEET_DOCS = ("docs/fleet.md",)
-# kinds whose names belong in docs/observability.md / docs/fleet.md;
-# everything else is the serving surface
+ROBUSTNESS_DOCS = ("docs/robustness.md",)
+# kinds whose names belong in docs/observability.md / docs/fleet.md /
+# docs/robustness.md specifically; everything else is the serving
+# surface
 OBS_KINDS = ("trace event type", "recorder event kind", "metric")
 FLEET_KINDS = ("FleetConfig field", "fleet stats() key")
+INTEGRITY_KINDS = ("integrity surface",)
+# the data-integrity surface (knobs + counters) must be named in the
+# "Data integrity" doc itself, docs/robustness.md — not merely
+# somewhere in the combined serving text. Each name listed here is
+# additionally cross-checked against the live config/stats surfaces,
+# so a renamed knob breaks the lint instead of silently unpinning it.
+INTEGRITY_NAMES = (
+    "verify_artifacts", "scrub_interval_ticks", "scrub_spill_blocks",
+    "sdc_check_interval_ticks",
+    "num_corruptions_detected", "num_import_refusals", "num_scrubs",
+    "num_scrub_blocks_verified", "num_spill_refused",
+    "num_spill_corrupt_discards",
+    "num_corrupt_checkpoints", "num_refused_imports",
+    "num_sdc_checks", "num_sdc_suspects",
+)
 
 
 def _docs_text(files) -> str:
@@ -92,6 +109,17 @@ def collect_names():
     register_engine_metrics(registry)
     register_train_metrics(registry)
     names += [("metric", n) for n in registry.names()]
+    # the integrity surface: every INTEGRITY_NAMES entry must (a)
+    # exist on a live surface collected above — the list cannot name
+    # phantoms — and (b) be named in docs/robustness.md specifically
+    live = {n for _, n in names}
+    for n in INTEGRITY_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"INTEGRITY_NAMES lists {n!r}, which is no longer a "
+                "live EngineConfig/FleetConfig field or stats() key — "
+                "update tools/check_docs.py")
+        names.append(("integrity surface", n))
     return names
 
 
@@ -99,12 +127,15 @@ def main():
     serving_text = _docs_text(SERVING_DOCS)
     obs_text = _docs_text(OBS_DOCS)
     fleet_text = _docs_text(FLEET_DOCS)
+    robustness_text = _docs_text(ROBUSTNESS_DOCS)
     missing = []
     for kind, name in collect_names():
         if kind in OBS_KINDS:
             text, where = obs_text, OBS_DOCS
         elif kind in FLEET_KINDS:
             text, where = fleet_text, FLEET_DOCS
+        elif kind in INTEGRITY_KINDS:
+            text, where = robustness_text, ROBUSTNESS_DOCS
         else:
             text, where = serving_text, SERVING_DOCS
         if name not in text:

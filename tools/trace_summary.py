@@ -130,6 +130,26 @@ def summarize(dump: Dict) -> str:
             f"({sum(int(e.get('bytes', 0)) for e in spills)} bytes), "
             f"{sum(int(e.get('blocks', 0)) for e in uploads)} blocks "
             f"re-admitted by upload across {len(uploads)} admissions")
+    scrubs = [e for e in rec_events if e.get("kind") == "scrub"]
+    corrupts = [e for e in rec_events
+                if e.get("kind") == "corruption_detected"]
+    suspects = [e for e in rec_events if e.get("kind") == "sdc_suspect"]
+    if scrubs or corrupts or suspects:
+        sites: Dict[str, int] = {}
+        for e in corrupts:
+            s = str(e.get("site"))
+            sites[s] = sites.get(s, 0) + 1
+        by_site = (" (" + ", ".join(f"{k}={v}" for k, v in
+                                    sorted(sites.items())) + ")"
+                   if sites else "")
+        retired = (" (" + ", ".join(f"replica {e.get('replica')}"
+                                    for e in suspects) + ")"
+                   if suspects else "")
+        lines.append(
+            f"-- integrity: {len(scrubs)} scrubs verifying "
+            f"{sum(int(e.get('verified', 0)) for e in scrubs)} blocks, "
+            f"{len(corrupts)} corruptions caught{by_site}, "
+            f"{len(suspects)} SDC suspects retired{retired}")
     incidents = rec.get("incidents") or []
     for inc in incidents:
         lines.append(
