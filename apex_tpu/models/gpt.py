@@ -59,6 +59,41 @@ from apex_tpu.normalization import FusedLayerNorm
 
 _INIT = nn.initializers.normal(stddev=0.02)
 
+# serving-mesh layout (docs/serving.md "Mesh sharding"): the modules
+# whose output dim splits over the mesh's "model" axis (qkv columns =
+# heads; mlp_in columns = the 4h expansion) and those whose INPUT dim
+# splits to match (the Megatron row-parallel halves, whose partial
+# products GSPMD all-reduces). Everything else — embeddings,
+# layernorms — replicates.
+_COL_PARALLEL = ("attn_q", "attn_k", "attn_v", "mlp_in")
+_ROW_PARALLEL = ("attn_out", "mlp_out")
+
+
+def gpt_param_pspec(path, model_axis: str = "model"):
+    """:class:`~jax.sharding.PartitionSpec` for one GPT param leaf,
+    keyed by its pytree path (``jax.tree_util.tree_map_with_path``
+    keys) — the model-owned half of the serving mesh layout
+    (:mod:`apex_tpu.serving.mesh` binds it to a concrete mesh):
+
+    - ``attn_q``/``attn_k``/``attn_v``/``mlp_in`` kernels
+      column-shard (``P(None, model)``) with their biases along
+      (``P(model)``) — qkv columns are head-major, so the head split
+      of the KV pools lines up with the projection split;
+    - ``attn_out``/``mlp_out`` kernels row-shard (``P(model, None)``),
+      biases replicated (they add after the all-reduce);
+    - ``wte``/``wpe``/layernorms replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    module = names[-2] if len(names) >= 2 else ""
+    leaf = names[-1] if names else ""
+    if module in _COL_PARALLEL:
+        return P(None, model_axis) if leaf == "kernel" else P(model_axis)
+    if module in _ROW_PARALLEL:
+        return P(model_axis, None) if leaf == "kernel" else P()
+    return P()
+
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:

@@ -1400,6 +1400,17 @@ def paged_prefill_attention(q, k_pages, v_pages, block_tables, q_positions,
         path below remains the universal fallback.
 
     Returns ``[B, C, H, D]`` in ``q.dtype``.
+
+    Mesh sharding (docs/serving.md): under the engine's GSPMD mesh
+    the pool, the scales, and the queries all arrive sharded on the
+    HEAD axis (``H`` over ``"model"``), and the whole chain here is
+    head-elementwise — gather and mask index only block/position
+    axes, the softmax reduces over keys, both einsums contract ``d``
+    or ``k`` per head — so GSPMD partitions it with ZERO collectives;
+    the all-reduce lives in the model's row-parallel output
+    projection, not in attention. (The fused Pallas route is
+    single-device: the engine rejects the env flag on a sharded
+    model axis.)
     """
     B, C, H, D = q.shape
     N = k_pages.shape[0]

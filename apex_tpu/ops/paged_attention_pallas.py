@@ -41,6 +41,12 @@ static shape gate (:func:`pallas_paged_read_supported`) keeps the XLA
 chain as the universal fallback — interpret mode (every non-TPU
 backend) always qualifies, native TPU additionally needs lane/sublane-
 tileable blocks and a VMEM-feasible score scratch.
+
+SINGLE-DEVICE ONLY: ``pallas_call`` has no SPMD partitioning rule, so
+the kernel cannot run over a GSPMD-sharded pool (docs/serving.md
+"Mesh sharding" — the engine rejects the env flag when its mesh's
+``model`` axis is > 1, where the XLA chain partitions collective-free
+instead; a future shard_map-wrapped variant could lift this).
 """
 
 from __future__ import annotations

@@ -2,7 +2,9 @@
 
 Paged KV-cache (:mod:`~apex_tpu.serving.kv_cache`), continuous-batching
 prefill/decode engine (:mod:`~apex_tpu.serving.engine`), jit-stable
-sampling (:mod:`~apex_tpu.serving.sampling`), and the crash-tolerant
+sampling (:mod:`~apex_tpu.serving.sampling`), the GSPMD mesh layout
+that shards an engine over a ``("batch", "model")`` device mesh
+(:mod:`~apex_tpu.serving.mesh`), and the crash-tolerant
 multi-replica fleet router (:mod:`~apex_tpu.serving.fleet`); design
 notes in docs/serving.md and docs/fleet.md. The training-side capability surface (amp dtype
 policy, the flash-attention kernel family, the GPT/BERT models) is
@@ -30,6 +32,14 @@ from apex_tpu.serving.fleet import (  # noqa: F401
     FleetConfig,
     FleetFailedError,
     FleetRouter,
+)
+from apex_tpu.serving.mesh import (  # noqa: F401
+    MESH_AXES,
+    build_mesh,
+    expected_collectives,
+    shard_cache,
+    shard_params,
+    validate_mesh_shape,
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     DEFAULT_TENANT,

@@ -181,6 +181,23 @@ chain hash and re-admit by device upload instead of recompute —
 token-identical, audit-only in snapshots. The read chain itself can
 run as one fused Pallas kernel (``APEX_PAGED_ATTENTION_PALLAS=1``,
 read side only, fp path bit-identical to the XLA chain).
+
+**Mesh sharding** (docs/serving.md): ``mesh_shape`` promotes the
+engine from single-device to mesh-native over a logical
+``("batch", "model")`` GSPMD mesh (:mod:`apex_tpu.serving.mesh`) —
+the KV pools (payloads AND quantized scales) and the model's
+qkv/proj/mlp weights shard their head axis over ``model`` via
+:class:`~jax.sharding.NamedSharding` annotations, and the same three
+jitted programs compile once under the mesh with the collectives
+jit-inserted (``audit_collectives`` pins the program-shape contract:
+zero collectives at a 1-sized model axis, all-reduce traffic once
+heads split). Everything host-side — admission, DRR, quotas, the
+ladder, drafters, snapshot/spill/integrity — is mesh-agnostic (block
+ids and chain hashes are layout-independent), so prefix caching, the
+spill tier, and fleet migration work unchanged at any shape. Mesh
+``(1, 1)``, the default, is certified bit-identical to the pre-mesh
+engine; ``mesh_shape`` is part of the restore-fingerprint identity
+set.
 """
 
 from __future__ import annotations
@@ -227,6 +244,7 @@ from apex_tpu.serving.kv_cache import (
     kv_block_bytes,
     seq_block_hashes,
 )
+from apex_tpu.serving import mesh as mesh_lib
 from apex_tpu.serving.drafter import NgramDrafter
 from apex_tpu.serving.sampling import (
     SamplingParams,
@@ -441,6 +459,21 @@ class EngineConfig:
     # snapshots and the knob stays out of the restore fingerprint —
     # a re-admitted block is certified token-identical to recompute.
     spill_max_bytes: Optional[int] = None
+    # -- pod-scale serving (docs/serving.md, "Mesh sharding") ----------
+    # The logical ("batch", "model") GSPMD device mesh the engine's
+    # programs compile under (apex_tpu.serving.mesh): the KV pools and
+    # the model's qkv/proj/mlp weights shard their HEAD axis over
+    # "model" via NamedSharding annotations and jax.jit inserts the
+    # collectives — the host-side machinery (admission, DRR, quotas,
+    # ladder, drafters, snapshot/spill/integrity) is mesh-agnostic.
+    # (1, 1) — the default — is certified bit-identical to the
+    # pre-mesh engine (outputs, statuses, full stats()), and the
+    # model-axis size must divide the model's num_heads (checked at
+    # engine construction, where the model is known). IDENTITY, not
+    # operational: mesh_shape stays in the restore fingerprint —
+    # sharded snapshots restore across EQUAL meshes only (the records
+    # themselves are host-side and layout-free).
+    mesh_shape: Tuple[int, int] = (1, 1)
     # Donate the cache pool to the jitted steps so XLA updates it in
     # place instead of materializing a second pool + copy per step
     # (double peak HBM and a full-pool write otherwise). Default off:
@@ -595,6 +628,12 @@ class EngineConfig:
             raise ValueError(
                 f"kv_quantization must be one of {KV_QUANT_MODES}, "
                 f"got {self.kv_quantization!r}")
+        # normalize (a caller's list restores as the identical
+        # fingerprint value) and validate the mesh geometry against the
+        # backend; the num_heads divisibility half runs at engine
+        # construction, where the model is known
+        object.__setattr__(self, "mesh_shape",
+                           mesh_lib.validate_mesh_shape(self.mesh_shape))
         if self.spill_max_bytes is not None:
             if self.spill_max_bytes < 1:
                 raise ValueError(
@@ -1042,7 +1081,8 @@ class InferenceEngine:
     """
 
     def __init__(self, model, params, config: EngineConfig, *,
-                 drafter=None, faults=None, clock=None, obs=None):
+                 drafter=None, faults=None, clock=None, obs=None,
+                 mesh=None):
         cfg = model.cfg
         self.model = model
         self.params = params
@@ -1126,6 +1166,51 @@ class InferenceEngine:
             cfg.num_layers, config.num_blocks, config.block_size,
             cfg.num_heads, head_dim, dtype=config.kv_dtype,
             quantization=config.kv_quantization)
+        # -- the GSPMD mesh (docs/serving.md, "Mesh sharding") ----------
+        # The config's shape was geometry-validated at construction;
+        # the model-dependent half (heads must split evenly) runs here.
+        # ``mesh=`` lets a fleet router build ONE mesh and thread it
+        # through every replica (equal NamedShardings across replicas
+        # by construction); it must agree with the config.
+        mesh_lib.validate_mesh_shape(config.mesh_shape,
+                                     num_heads=cfg.num_heads)
+        if mesh is not None:
+            if (tuple(mesh.axis_names) != mesh_lib.MESH_AXES
+                    or tuple(mesh.devices.shape)
+                    != tuple(config.mesh_shape)):
+                raise ValueError(
+                    f"mesh= (axes {tuple(mesh.axis_names)}, shape "
+                    f"{tuple(mesh.devices.shape)}) does not match "
+                    f"mesh_shape {tuple(config.mesh_shape)} over axes "
+                    f"{mesh_lib.MESH_AXES}")
+            self.mesh = mesh
+        else:
+            self.mesh = mesh_lib.build_mesh(config.mesh_shape)
+        if config.mesh_shape[1] > 1:
+            from apex_tpu.ops.paged_attention_pallas import (
+                pallas_paged_read_wanted)
+            if pallas_paged_read_wanted():
+                # the fused Pallas read kernel is a single-device
+                # program (no SPMD partitioning rule); under a sharded
+                # pool it would fail at trace time with a far worse
+                # error than this one
+                raise ValueError(
+                    "APEX_PAGED_ATTENTION_PALLAS is incompatible with "
+                    f"a sharded model axis (mesh_shape "
+                    f"{tuple(config.mesh_shape)}): the fused paged-read "
+                    "kernel is single-device — unset the flag or run "
+                    "mesh (1, 1)")
+        # weights and KV pools commit to their mesh layout (head axis
+        # over "model"; see gpt.gpt_param_pspec / KVCache.
+        # partition_specs), and every jitted program pins its returned
+        # cache to the same layout — without the out_shardings pin,
+        # GSPMD may hand back a different pool layout and the next
+        # dispatch's changed input sharding would recompile, breaking
+        # the one-program compile-count contract
+        self.params = mesh_lib.shard_params(self.mesh, self.params)
+        self.cache = mesh_lib.shard_cache(self.mesh, self.cache)
+        self._program_out = mesh_lib.program_out_shardings(self.mesh,
+                                                           self.cache)
         # the tenant ledger's per-block charge unit: a quantized block
         # charges its reduced byte footprint relative to the full-
         # precision block this config would otherwise store, so
@@ -1174,7 +1259,8 @@ class InferenceEngine:
             # compile-count contract is untouched)
             self._upload = jax.jit(
                 self._upload_impl,
-                donate_argnums=(0,) if config.donate_cache else ())
+                donate_argnums=(0,) if config.donate_cache else (),
+                **self._cache_out_kw())
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
         self.waiting = _WaitingQueue(weights=config.tenant_weights,
                                      quantum=config.drr_quantum)
@@ -1287,13 +1373,29 @@ class InferenceEngine:
         # (zero-proposal lanes run through it as single-token steps, so
         # no second "fallback" program ever exists).
         donate = (1,) if config.donate_cache else ()
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate)
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate,
+                                **self._pair_out_kw())
         self._decode = jax.jit(
             self._spec_decode_impl if config.spec_tokens > 0
             else self._decode_impl,
-            donate_argnums=donate)
+            donate_argnums=donate, **self._pair_out_kw())
         self._cow = jax.jit(
-            copy_block, donate_argnums=(0,) if config.donate_cache else ())
+            copy_block, donate_argnums=(0,) if config.donate_cache else (),
+            **self._cache_out_kw())
+
+    def _pair_out_kw(self) -> Dict[str, object]:
+        """``jax.jit`` kwargs pinning a ``(cache, tokens)`` program's
+        output layout to the mesh (empty when the mesh layer is
+        neutered — the pre-mesh jit, byte for byte)."""
+        if self._program_out is None:
+            return {}
+        return {"out_shardings": self._program_out}
+
+    def _cache_out_kw(self) -> Dict[str, object]:
+        """Same, for the cache-only programs (CoW copy, spill upload)."""
+        if self._program_out is None:
+            return {}
+        return {"out_shardings": self._program_out[0]}
 
     # -- the jitted programs ----------------------------------------------
 
@@ -3589,6 +3691,11 @@ class InferenceEngine:
         d = dataclasses.asdict(self.config)
         d["kv_dtype"] = (None if self.config.kv_dtype is None
                          else str(jnp.dtype(self.config.kv_dtype)))
+        # as a LIST, not a tuple: the fingerprint must compare equal
+        # before and after riding the JSON wire (which has no tuples),
+        # and mesh_shape IS identity — a sharded snapshot restores
+        # across equal meshes only
+        d["mesh_shape"] = [int(v) for v in self.config.mesh_shape]
         for knob in ("max_dispatch_retries", "retry_backoff_s",
                      # the spill tier is operational capacity tuning:
                      # a re-admitted block is certified token-identical
@@ -3962,6 +4069,89 @@ class InferenceEngine:
         if self._obs is not None:
             self._obs.record("restore", requests=len(snap["requests"]))
 
+    # -- mesh program-shape audit (docs/serving.md, "Mesh sharding") -------
+
+    def program_collective_stats(self, program: str) -> Dict[str, Dict]:
+        """Collective ops/bytes of one compiled engine program
+        (:func:`apex_tpu.utils.hlo_audit.collective_stats`), lowered
+        from ABSTRACT arguments at the program's real call shapes and
+        the engine's committed shardings — no dispatch runs, and the
+        explicit AOT lowering leaves the jit call caches (the pinned
+        ``*_compilations`` counters) untouched. ``program``:
+        ``"prefill"``, ``"decode"``, or ``"verify"`` (the last two are
+        the same jit slot — ``"verify"`` just insists speculation is
+        on, so a contract test cannot silently audit the wrong
+        program)."""
+        B = self.config.max_batch
+        M = self.max_blocks_per_seq
+
+        def i32(shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        def f32(shape):
+            return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+        def abstract(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=getattr(x, "sharding",
+                                                         None))
+
+        aparams = jax.tree.map(abstract, self.params)
+        acache = jax.tree.map(abstract, self.cache)
+        if program == "prefill":
+            C = self._chunk
+            fn, args = self._prefill, (
+                aparams, acache, i32((1, C)), i32((1, C)), i32((1,)),
+                i32((1,)), i32((1,)), i32((1, M)),
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                f32((1,)), i32((1,)), f32((1,)))
+        elif program in ("decode", "verify"):
+            if program == "verify" and self.config.spec_tokens < 1:
+                raise ValueError(
+                    "program 'verify' requires spec_tokens >= 1 (the "
+                    "decode slot holds the plain scan otherwise)")
+            keys = jax.ShapeDtypeStruct((B, 2), jnp.uint32)
+            if self.config.spec_tokens > 0:
+                S = self.config.spec_tokens
+                args = (aparams, acache, i32((B,)), i32((B, S)),
+                        i32((B,)), i32((B, M)), i32((B,)), i32((B,)),
+                        i32((B,)), i32((B,)), keys, f32((B,)),
+                        i32((B,)), f32((B,)))
+            else:
+                args = (aparams, acache, i32((B,)), i32((B, M)),
+                        i32((B,)), i32((B,)), i32((B,)), i32((B,)),
+                        keys, f32((B,)), i32((B,)), f32((B,)))
+            fn = self._decode
+        else:
+            raise ValueError(
+                f"unknown program {program!r} (expected 'prefill', "
+                "'decode', or 'verify')")
+        from apex_tpu.utils.hlo_audit import collective_stats
+
+        return collective_stats(fn.lower(*args).compile().as_text())
+
+    def audit_collectives(self) -> Dict[str, Dict[str, Dict]]:
+        """Check every compiled program against the mesh's collective
+        contract (:func:`apex_tpu.serving.mesh.expected_collectives`):
+        zero collectives while the model axis is 1 (the bit-identity
+        precondition), reduction traffic — and nothing exotic — once
+        the heads split. Raises ``AssertionError`` on violation;
+        returns ``{program: collective_stats}`` for reporting."""
+        from apex_tpu.utils.hlo_audit import assert_collective_contract
+
+        contract = mesh_lib.expected_collectives(self.config.mesh_shape)
+        out = {}
+        programs = ["prefill",
+                    "verify" if self.config.spec_tokens > 0 else "decode"]
+        for prog in programs:
+            stats = self.program_collective_stats(prog)
+            assert_collective_contract(
+                stats,
+                label=f"{prog}@mesh{tuple(self.config.mesh_shape)}",
+                **contract)
+            out[prog] = stats
+        return out
+
     def check_allocator_integrity(self) -> None:
         """Cross-check the allocator against the engine's own
         bookkeeping: internal invariants plus an EXACT refcount match —
@@ -4001,6 +4191,12 @@ class InferenceEngine:
         out = {
             "prefill_compilations": self._prefill._cache_size(),
             "decode_compilations": self._decode._cache_size(),
+            # the GSPMD mesh the programs compiled under (docs/
+            # serving.md "Mesh sharding"): static per config, so equal
+            # configs keep full-stats identity certs byte-comparable
+            "mesh_devices": (self.config.mesh_shape[0]
+                             * self.config.mesh_shape[1]),
+            "mesh_model_axis": self.config.mesh_shape[1],
             "num_prefills": self._num_prefills,
             "num_prefill_chunks": self._num_prefill_chunks,
             "num_decode_dispatches": self._num_decode_dispatches,

@@ -109,6 +109,7 @@ from apex_tpu.serving.kv_cache import (
     blocks_needed,
     seq_block_hashes,
 )
+from apex_tpu.serving.mesh import build_mesh
 from apex_tpu.utils.integrity import (
     IntegrityError,
     seal_record,
@@ -278,6 +279,17 @@ class FleetRouter:
                           else [None] * n)
         self._faults = (list(faults) if faults is not None
                         else [None] * n)
+        # ONE GSPMD mesh, threaded through every replica (and every
+        # respawn): replicas of a mesh-sharded engine are mesh-sharded
+        # replicas (docs/serving.md "Mesh sharding") — equal mesh +
+        # equal config is what keeps migration/failover records
+        # replayable bit-identically across them, and the in-process
+        # fleet deliberately SHARES the device set (a multi-process
+        # deployment gives each replica its own slice; the router's
+        # replica surface is already process-separable). All the
+        # router's own machinery — placement, checkpoints, migration,
+        # SDC cross-checks — is host-side and mesh-agnostic.
+        self.mesh = build_mesh(engine_config.mesh_shape)
         self.replicas: List[_Replica] = [self._spawn(i)
                                          for i in range(n)]
         # fleet-wide request tracking: owner replica per live uid, the
@@ -341,7 +353,7 @@ class FleetRouter:
         return _Replica(engine=InferenceEngine(
             self.model, self.params, self.engine_config,
             drafter=self._drafters[idx], faults=self._faults[idx],
-            clock=self._clock))
+            clock=self._clock, mesh=self.mesh))
 
     # -- placement ---------------------------------------------------------
 

@@ -77,6 +77,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
 from apex_tpu.utils.integrity import payload_checksum
 
@@ -207,6 +208,22 @@ class KVCache(NamedTuple):
     @property
     def head_dim(self) -> int:
         return self.k.shape[4]
+
+    def partition_specs(self, model_axis: str = "model") -> "KVCache":
+        """The pool's mesh layout (docs/serving.md, "Mesh sharding"):
+        a :class:`~jax.sharding.PartitionSpec` per pool, sharding the
+        HEAD axis over ``model_axis`` — heads are the one axis the
+        paged ops never index by data (scatter/gather/CoW/defrag all
+        address layer/block/slot), so a head split needs zero
+        collectives for pool maintenance, and the per-row scale pools
+        split on the same axis so a block's scales stay colocated with
+        its bytes. Returned as a KVCache-of-specs so callers
+        ``tree.map`` it against the pool (``None`` scale fields line
+        up with ``None`` specs)."""
+        payload = PartitionSpec(None, None, None, model_axis, None)
+        scale = (None if self.k_scale is None
+                 else PartitionSpec(None, None, None, model_axis))
+        return KVCache(k=payload, v=payload, k_scale=scale, v_scale=scale)
 
     @classmethod
     def create(cls, num_layers: int, num_blocks: int, block_size: int,

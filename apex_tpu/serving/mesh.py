@@ -1,0 +1,176 @@
+"""GSPMD mesh layout for the inference engine (docs/serving.md,
+"Mesh sharding").
+
+The engine promotes from single-device to **mesh-native** through the
+SNIPPETS.md [2] pattern: a logical 2-D device mesh with named axes
+``("batch", "model")``, :class:`~jax.sharding.NamedSharding`
+annotations on the weight and KV-pool tensors, and plain ``jax.jit`` —
+the XLA SPMD partitioner inserts the collectives. Nothing about the
+engine's host-side machinery (admission, DRR, quotas, the degradation
+ladder, drafters, snapshot/spill/integrity) changes with the mesh:
+block ids and SHA-256 chain hashes are layout-independent, so prefix
+caching, the spill tier, and fleet migration records work unchanged at
+any mesh shape.
+
+What shards where (the full table lives in docs/serving.md):
+
+- **KV pools** (``KVCache.k``/``v`` ``[L, N, bs, H, D]`` and the
+  quantized ``k_scale``/``v_scale`` ``[L, N, bs, H]``): the head axis
+  ``H`` splits over ``model`` (:meth:`KVCache.partition_specs`). Every
+  paged scatter/gather/CoW/defrag op indexes only layer/block/slot
+  axes, so pool maintenance never crosses the mesh.
+- **GPT weights**: the Megatron decomposition via annotation — qkv and
+  ``mlp_in`` kernels column-sharded (``P(None, "model")``, biases
+  ``P("model")``), ``attn_out``/``mlp_out`` kernels row-sharded
+  (``P("model", None)``), embeddings/layernorms replicated
+  (:func:`~apex_tpu.models.gpt.gpt_param_pspec`). GSPMD then keeps
+  activations head-sharded through attention and all-reduces the two
+  row-parallel projections per block.
+- **Everything else** — block tables, per-lane sampling arrays, PRNG
+  keys, emitted tokens — is replicated: per-tick metadata is tiny, and
+  replication is what keeps the sampler and the drain byte-identical
+  across mesh shapes.
+
+The ``batch`` axis is declared (the pod story: data-parallel replicas
+of the same program) but nothing currently shards over it — a
+``(B, 1)`` mesh is collective-free like ``(1, 1)``.
+
+**Identity contract**: mesh ``(1, 1)`` — the default — reproduces the
+pre-mesh engine bit for bit (outputs, statuses, the full ``stats()``
+dict; a 1-device SPMD partition is a no-op and the certification test
+pins it), and :func:`expected_collectives` is the program-shape
+contract ``hlo_audit`` checks: zero collectives at a 1-sized ``model``
+axis, all-reduces (and nothing exotic) once the heads actually split.
+``mesh_shape`` is part of the engine's restore-fingerprint identity
+set: sharded snapshots restore across EQUAL meshes (the records
+themselves are host-side and layout-free).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("batch", "model")
+
+
+def validate_mesh_shape(mesh_shape, num_heads: Optional[int] = None,
+                        knob: str = "mesh_shape") -> Tuple[int, int]:
+    """Validate (and normalize to a tuple) a ``(batch, model)`` mesh
+    shape: two positive ints, a device footprint the backend can
+    actually supply (checked lazily — the trivial ``(1, 1)`` never
+    touches the backend, so constructing a default config cannot
+    trigger plugin init), and — when the caller knows the model — a
+    ``model``-axis size dividing ``num_heads`` (the KV pools and the
+    qkv projections shard over heads; a non-dividing split has no
+    layout). Named-knob errors, matching the config validation style."""
+    try:
+        shape = tuple(int(v) for v in mesh_shape)
+        if any(s != v for s, v in zip(shape, mesh_shape)):
+            raise ValueError   # non-integral axis (e.g. 1.5)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{knob} must be a (batch, model) pair of ints, "
+            f"got {mesh_shape!r}")
+    if len(shape) != 2:
+        raise ValueError(
+            f"{knob} must have exactly 2 axes (batch, model), "
+            f"got {mesh_shape!r}")
+    if any(v < 1 for v in shape):
+        raise ValueError(
+            f"{knob} axes must be >= 1, got {mesh_shape!r}")
+    n = shape[0] * shape[1]
+    if n > 1 and n > jax.device_count():
+        raise ValueError(
+            f"{knob} {shape} needs {n} devices but only "
+            f"{jax.device_count()} are available "
+            f"(CPU: XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    if num_heads is not None and num_heads % shape[1]:
+        raise ValueError(
+            f"{knob} model axis ({shape[1]}) must divide the model's "
+            f"num_heads ({num_heads}): the KV pools and qkv projections "
+            "shard over heads")
+    return shape
+
+
+def build_mesh(mesh_shape) -> Mesh:
+    """The logical ``("batch", "model")`` device mesh for a validated
+    shape — the first ``batch * model`` backend devices, row-major
+    (deterministic, so equal shapes on equal processes build equal
+    meshes and :class:`~jax.sharding.NamedSharding` keys compare
+    equal across engine replicas)."""
+    shape = validate_mesh_shape(mesh_shape)
+    devices = np.asarray(jax.devices()[: shape[0] * shape[1]])
+    return Mesh(devices.reshape(shape), MESH_AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The fully-replicated sharding of ``mesh`` — every per-tick
+    scalar/metadata tensor's layout."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def cache_shardings(mesh: Mesh, cache):
+    """``NamedSharding`` pytree for a :class:`~apex_tpu.serving.
+    kv_cache.KVCache`: the pool's head axis over ``model``
+    (:meth:`KVCache.partition_specs` owns the spec layout; this binds
+    it to a concrete mesh). Also the ``out_shardings`` every jitted
+    program pins its returned cache to — without the pin, GSPMD may
+    hand back a differently-laid-out pool and the next dispatch's
+    changed input sharding would recompile, breaking the one-program
+    compile-count contract."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        cache.partition_specs())
+
+
+def shard_cache(mesh: Mesh, cache):
+    """Commit a KV pool to its mesh layout."""
+    return jax.tree.map(jax.device_put, cache, cache_shardings(mesh, cache))
+
+
+def shard_params(mesh: Mesh, params, pspec_fn=None):
+    """Commit a param pytree to the mesh: each leaf device_put with the
+    :class:`~jax.sharding.PartitionSpec` ``pspec_fn(path)`` names
+    (default: the GPT layout,
+    :func:`~apex_tpu.models.gpt.gpt_param_pspec` — a model with a
+    different parameter tree supplies its own path->spec rule)."""
+    if pspec_fn is None:
+        from apex_tpu.models.gpt import gpt_param_pspec
+        pspec_fn = gpt_param_pspec
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.device_put(
+            x, NamedSharding(mesh, pspec_fn(path))),
+        params)
+
+
+def program_out_shardings(mesh: Mesh, cache):
+    """The ``(cache, tokens)`` output-sharding pair of the engine's
+    prefill/decode/verify programs: the pool pinned to its mesh
+    layout, emitted tokens replicated (the host drains them). Returned
+    as a 2-tuple the engine threads into ``jax.jit(out_shardings=...)``
+    (cache-only programs — CoW copy, spill upload — use element 0)."""
+    return cache_shardings(mesh, cache), replicated(mesh)
+
+
+def expected_collectives(mesh_shape) -> dict:
+    """The sharded program-shape contract for
+    :func:`apex_tpu.utils.hlo_audit.assert_collective_contract`: with a
+    1-sized ``model`` axis every program must lower collective-free
+    (nothing to synchronize — the bit-identity certification leans on
+    this); once heads split, the Megatron-via-GSPMD layout must show
+    cross-partition reduction traffic (all-reduce, or the
+    reduce-scatter + all-gather pair XLA sometimes splits one into)
+    and must NOT show all-to-all (a resharding of the sequence or head
+    axis this layout never asks for — its appearance means the
+    partitioner lost the intended layout somewhere)."""
+    shape = validate_mesh_shape(mesh_shape)
+    if shape[1] == 1:
+        return {"exact_total_ops": 0}
+    return {
+        "min_ops": {"all-reduce": 1},
+        "alt_min_ops": {"reduce-scatter": 1, "all-gather": 1},
+        "forbidden": ("all-to-all",),
+    }

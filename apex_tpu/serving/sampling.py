@@ -43,6 +43,15 @@ everywhere): the predicate is traced, so one compiled program serves
 both regimes, but an all-greedy batch skips the sort/filter/softmax
 chain at run time — a micro-win paid on every decode iteration and
 every speculative verify step.
+
+The sampler is deliberately MESH-OBLIVIOUS (docs/serving.md, "Mesh
+sharding"): by the time logits reach it they are replicated — the
+model's row-parallel projections all-reduced the last sharded
+contraction — and every op here (argmax, the descending sort, the
+rank/mass masks, the categorical draws) reduces over the UNSHARDED
+vocabulary axis with per-lane keys, so the engine's sharded programs
+sample bit-identically to the single-device ones at any mesh shape
+and sampling adds zero collectives of its own.
 """
 
 from __future__ import annotations

@@ -159,6 +159,54 @@ def lowered_alias_stats(jitted, *args, **kwargs) -> Dict:
         jitted.lower(*args, **kwargs).compile().as_text())
 
 
+def assert_collective_contract(stats: Dict[str, Dict[str, int]],
+                               exact_total_ops: int = None,
+                               min_ops: Dict[str, int] = None,
+                               alt_min_ops: Dict[str, int] = None,
+                               forbidden=(),
+                               label: str = "program") -> None:
+    """Check a program-shape collective contract against
+    :func:`collective_stats` output, raising ``AssertionError`` with
+    the full per-kind table on any violation — the serving engine's
+    sharded-program audit (docs/serving.md "Mesh sharding";
+    ``apex_tpu.serving.mesh.expected_collectives`` builds the expected
+    kwargs per mesh shape).
+
+    - ``exact_total_ops``: the total op count must equal this (0 is
+      the single-partition contract: a program that must lower
+      collective-free).
+    - ``min_ops``: per-kind op-count floors that must ALL hold — or,
+      when ``alt_min_ops`` is given, the alternative set may hold
+      instead (XLA legitimately lowers one all-reduce as a
+      reduce-scatter + all-gather pair; either spelling satisfies the
+      reduction contract, and hlo_audit's own round-5 lesson is that
+      the two must be counted as equivalent, not compared raw).
+    - ``forbidden``: kinds whose op count must be zero.
+    """
+    table = {k: v["ops"] for k, v in stats.items() if k != "total"}
+    total = stats.get("total", {}).get("ops", sum(table.values()))
+    if exact_total_ops is not None and total != exact_total_ops:
+        raise AssertionError(
+            f"{label}: expected exactly {exact_total_ops} collective "
+            f"op(s), compiled program has {total} ({table})")
+    for kind in forbidden:
+        if stats.get(kind, {}).get("ops", 0):
+            raise AssertionError(
+                f"{label}: forbidden collective kind {kind!r} present "
+                f"({table})")
+
+    def _meets(floors):
+        return all(stats.get(k, {}).get("ops", 0) >= n
+                   for k, n in floors.items())
+
+    if min_ops and not _meets(min_ops):
+        if not (alt_min_ops and _meets(alt_min_ops)):
+            raise AssertionError(
+                f"{label}: expected collective floors {min_ops}"
+                + (f" (or {alt_min_ops})" if alt_min_ops else "")
+                + f" not met by compiled program ({table})")
+
+
 def format_stats(stats: Dict[str, Dict[str, int]]) -> str:
     """One-line human summary of non-zero kinds (dryrun log format)."""
     parts = [f"{k}:{v['ops']}op/{v['bytes']}B"

@@ -316,6 +316,20 @@ def _reset():
     gc.collect()
 
 
+# every record this invocation printed (metric lines + section
+# records), so the end-of-run bench_diff report can compare THIS run
+# against the newest recorded BENCH_*.json without waiting for the
+# driver to write the new artifact
+_RUN_RECORDS = []
+
+
+def _print_record(rec):
+    """Print one JSON record line AND remember it for the end-of-run
+    bench_diff report."""
+    print(json.dumps(rec))
+    _RUN_RECORDS.append(rec)
+
+
 def _emit_section_record(name, status, wall_s, error=None):
     """One `{"section": ...}` JSON line per bench section: wall time +
     exit status, emitted whether the section lived or died. BENCH_r01
@@ -327,7 +341,46 @@ def _emit_section_record(name, status, wall_s, error=None):
            "wall_time_s": round(wall_s, 3)}
     if error is not None:
         rec["error"] = error
-    print(json.dumps(rec))
+    _print_record(rec)
+
+
+def _print_bench_diff_report():
+    """End-of-full-run satellite (round 15): compare THIS run's records
+    against the newest recorded ``BENCH_*.json`` with
+    ``tools/bench_diff.py`` and PRINT the report (stderr, so the
+    stdout record stream stays machine-parseable). The comparer landed
+    in round 13 but nothing invoked it — a section that quietly
+    vanished still read as a clean round to a human eyeballing metric
+    lines. Strictly informational here: a perf round must record its
+    numbers even when they regressed (the verdict line says which),
+    so this NEVER fails the run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sys.path.insert(0, here)
+        from tools.bench_diff import diff, parse_artifact
+
+        priors = sorted(f for f in os.listdir(here)
+                        if f.startswith("BENCH_") and f.endswith(".json"))
+        if not priors:
+            return
+        newest = os.path.join(here, priors[-1])
+        current = {"rc": None, "metrics": {}, "sections": {}}
+        for rec in _RUN_RECORDS:
+            if "metric" in rec:
+                current["metrics"][str(rec["metric"])] = rec
+            elif "section" in rec:
+                current["sections"][str(rec["section"])] = rec
+        rc, lines = diff(parse_artifact(newest), current)
+        print(f"== bench diff vs {priors[-1]} (informational — never "
+              "fails the run) ==", file=sys.stderr)
+        for line in lines:
+            print(line, file=sys.stderr)
+        print(f"== bench diff verdict: "
+              f"{'REGRESSIONS FLAGGED' if rc else 'ok'} ==",
+              file=sys.stderr)
+    except Exception as e:  # the report must never kill a perf round
+        print(f"# bench_diff report skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def _run_section(name, fn, retries=1):
@@ -338,7 +391,7 @@ def _run_section(name, fn, retries=1):
     last_err = None
     for attempt in range(retries + 1):
         try:
-            print(json.dumps(fn()))
+            _print_record(fn())
             _emit_section_record(name, "ok", time.perf_counter() - t0)
             return True
         except Exception as e:  # a dying section must not kill the run
@@ -2392,6 +2445,185 @@ def bench_serving_integrity(fast=False):
     }
 
 
+_MESH_SERVING_CHILD = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[1])
+fast = sys.argv[2] == "1"
+import jax, jax.numpy as jnp, numpy as np
+from apex_tpu.models import GPTConfig, GPTLMHeadModel
+from apex_tpu.serving import EngineConfig, InferenceEngine, Request
+from apex_tpu.serving import mesh as mesh_lib
+
+cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+model = GPTLMHeadModel(cfg)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(np.random.RandomState(0).randint(
+                        0, cfg.vocab_size, (1, 8))))
+n_req, plen, new = (8, 16, 12) if fast else (24, 32, 24)
+
+def make_reqs():
+    # greedy traffic: the cross-mesh token-identity assertion is
+    # certified for argmax lanes (fixed seeds; the sampled story is
+    # the tier-1 matrix's)
+    rr = np.random.RandomState(4)
+    return [Request(uid=f"m{i}",
+                    prompt=list(rr.randint(0, cfg.vocab_size, plen)),
+                    max_new_tokens=new) for i in range(n_req)]
+
+def econf(mesh_shape):
+    return EngineConfig(max_batch=8, block_size=8, num_blocks=64,
+                        max_prefill_len=16, max_seq_len=64,
+                        decode_steps=4, mesh_shape=mesh_shape, seed=9)
+
+def serve(eng, reqs):
+    for r in reqs:
+        eng.add_request(r)
+    return eng.run(return_status=True)
+
+# phase 0: mesh (1,1) bit-identity to the PRE-MESH engine (the mesh
+# layer neutered = the byte-identical old path), constant clock so the
+# full stats() dict is comparable
+CONST = lambda: 0.0
+mesh_eng = InferenceEngine(model, params, econf((1, 1)), clock=CONST)
+mesh_res = serve(mesh_eng, make_reqs())
+saved = (mesh_lib.shard_params, mesh_lib.shard_cache,
+         mesh_lib.program_out_shardings)
+mesh_lib.shard_params = lambda mesh, params, pspec_fn=None: params
+mesh_lib.shard_cache = lambda mesh, cache: cache
+mesh_lib.program_out_shardings = lambda mesh, cache: None
+try:
+    plain_eng = InferenceEngine(model, params, econf((1, 1)), clock=CONST)
+    plain_res = serve(plain_eng, make_reqs())
+finally:
+    (mesh_lib.shard_params, mesh_lib.shard_cache,
+     mesh_lib.program_out_shardings) = saved
+assert {u: (r.tokens, r.status) for u, r in mesh_res.items()} \
+    == {u: (r.tokens, r.status) for u, r in plain_res.items()}, \
+    "mesh (1,1) is not token/status-identical to the pre-mesh engine"
+assert mesh_eng.stats() == plain_eng.stats(), \
+    "mesh (1,1) perturbed the stats() dict"
+
+# phase 1: the same seeded greedy trace timed at (1,1) vs (1,2)
+def arm(mesh_shape):
+    eng = InferenceEngine(model, params, econf(mesh_shape))
+    eng.add_request(Request(uid="warm", prompt=[1] * 8, max_new_tokens=2))
+    eng.run()                       # compile outside the clock
+    reqs = make_reqs()
+    s0 = eng.stats()
+    t0 = time.perf_counter()
+    res = serve(eng, reqs)
+    dt = time.perf_counter() - t0
+    s1 = eng.stats()
+    toks = s1["num_tokens_decoded"] - s0["num_tokens_decoded"]
+    audit = eng.audit_collectives()     # raises on contract violation
+    return {
+        "mesh_shape": list(mesh_shape),
+        "decode_tokens_per_sec": round(toks / max(dt, 1e-9), 3),
+        "decode_tokens": int(toks),
+        "wall_s": round(dt, 4),
+        "prefill_compilations": int(s1["prefill_compilations"]),
+        "decode_compilations": int(s1["decode_compilations"]),
+        "collective_ops": {prog: int(st["total"]["ops"])
+                           for prog, st in audit.items()},
+        "allreduce_ops": {prog: int(st["all-reduce"]["ops"])
+                          for prog, st in audit.items()},
+        # the spelling-agnostic reduction count (hlo_audit's round-5
+        # lesson: XLA may lower one all-reduce as a reduce-scatter +
+        # all-gather pair; the raw all-reduce count is reported above
+        # as observed truth but never asserted on)
+        "reduction_ops": {
+            prog: int(st["all-reduce"]["ops"]
+                      + st["reduce-scatter"]["ops"])
+            for prog, st in audit.items()},
+    }, {u: r.tokens for u, r in res.items()}
+
+arm11, out11 = arm((1, 1))
+arm12, out12 = arm((1, 2))
+assert out11 == out12, \
+    "greedy request outputs diverged across mesh shapes"
+assert arm11["prefill_compilations"] == 1 \
+    and arm11["decode_compilations"] == 1, arm11
+assert arm12["prefill_compilations"] == 1 \
+    and arm12["decode_compilations"] == 1, arm12
+assert all(v == 0 for v in arm11["collective_ops"].values()), arm11
+assert all(v >= 1 for v in arm12["reduction_ops"].values()), arm12
+
+print(json.dumps({
+    "mesh11_bit_identical": True,
+    "cross_mesh_token_identical": True,
+    "num_requests": n_req,
+    "mesh_1x1": arm11,
+    "mesh_1x2": arm12,
+}))
+"""
+
+
+def bench_serving_mesh(fast=False):
+    """Pod-scale serving arm (round 15, docs/serving.md "Mesh
+    sharding"): the GSPMD mesh promotion, certified where it matters —
+    the SAME seeded greedy trace served at mesh (1, 1) and (1, 2).
+
+    Runs in a child process with TWO forced CPU host devices
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=2`` must be
+    set before JAX initializes, and the parent's backend is already
+    up), and asserts in-child: mesh (1, 1) BIT-identical to the
+    pre-mesh engine (outputs, statuses, full constant-clock stats —
+    the mesh layer neutered as the baseline), token-identity of every
+    request's output across mesh shapes, compile counts pinned at one
+    per program under both meshes, and the hlo_audit collective
+    contract (zero collectives at (1, 1); every program shows
+    all-reduce traffic at (1, 2) and the contract forbids
+    all-to-all). Reports decode tok/s per arm — on a shared-core
+    virtual mesh the (1, 2) arm pays the all-reduces without real
+    parallel compute, so ``vs_baseline`` (the (1,2)/(1,1) ratio) is
+    the honest collective-overhead number, not a speedup claim; on
+    real multi-chip hardware the same record becomes the scale-up
+    curve. ``fast=True`` is the tier-1 smoke shape."""
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           # the pallas read flag would make the child's (1,2) engine
+           # refuse construction (the kernel is single-device) — an
+           # operator exercising it on the OTHER serving sections must
+           # not kill the mesh arm
+           if k not in ("PALLAS_AXON_POOL_IPS",
+                        "APEX_PAGED_ATTENTION_PALLAS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, "-c", _MESH_SERVING_CHILD, here,
+         "1" if fast else "0"],
+        capture_output=True, text=True, timeout=600, env=env)
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-800:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["mesh11_bit_identical"] is True
+    assert rec["cross_mesh_token_identical"] is True
+    a11, a12 = rec["mesh_1x1"], rec["mesh_1x2"]
+    ratio = (a12["decode_tokens_per_sec"]
+             / max(a11["decode_tokens_per_sec"], 1e-9))
+    print(f"# serving-mesh: {rec['num_requests']} greedy requests, "
+          f"(1,1) {a11['decode_tokens_per_sec']:.1f} tok/s vs (1,2) "
+          f"{a12['decode_tokens_per_sec']:.1f} tok/s ({ratio:.2f}x); "
+          f"collectives (1,1) {a11['collective_ops']} -> (1,2) "
+          f"reductions {a12['reduction_ops']}; bit-identity + "
+          f"cross-mesh token identity held", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_mesh_decode_tokens_per_sec",
+        "value": a12["decode_tokens_per_sec"],
+        "unit": "tokens/sec",
+        # the honest cross-arm number on a virtual mesh: collective
+        # overhead, not parallel speedup (see docstring)
+        "vs_baseline": round(ratio, 3),
+        "mesh11_bit_identical": True,
+        "cross_mesh_token_identical": True,
+        "num_requests": int(rec["num_requests"]),
+        "arms": {"mesh_1x1": a11, "mesh_1x2": a12},
+    }
+
+
 def bench_train_step(fast=False):
     """Fused train step (apex_tpu.train): the whole global optimizer
     step — amp O2 scaled forward/backward, ``accum_steps`` scanned
@@ -2671,6 +2903,8 @@ def main():
              lambda: bench_serving_fleet(fast=True)),
             ("bench_serving_integrity",
              lambda: bench_serving_integrity(fast=True)),
+            ("bench_serving_mesh",
+             lambda: bench_serving_mesh(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -2726,7 +2960,7 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(dt_base / dt_opt, 3),
     }
-    print(json.dumps(result))
+    _print_record(result)
     _emit_section_record("headline", "ok",
                          time.perf_counter() - t_headline)
     # BASELINE configs[1]-[3] + the serving section (round 6) + the
@@ -2737,7 +2971,8 @@ def main():
                  bench_serving_speculative, bench_serving_overload,
                  bench_serving_multitenant, bench_serving_kv_memory,
                  bench_serving_fleet, bench_serving_integrity,
-                 bench_train_step, bench_obs_pipeline]
+                 bench_serving_mesh, bench_train_step,
+                 bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)
@@ -2759,6 +2994,9 @@ def main():
         # and its death must leave a "failed" section record
         _run_section(bench_fn.__name__, bench_fn, retries=1)
         _reset()
+    # the round-13 comparer, finally closing its own loop: diff THIS
+    # run against the newest recorded round (report only, stderr)
+    _print_bench_diff_report()
 
 
 if __name__ == "__main__":

@@ -93,6 +93,42 @@ def test_lint_detects_phantom_integrity_names(monkeypatch):
     assert phantom in missing
 
 
+def test_lint_detects_phantom_mesh_names(monkeypatch):
+    """The mesh surface is checked against docs/serving.md
+    specifically: a phantom mesh knob/stat must be flagged."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+    phantom = ("mesh surface", "phantom_mesh_axis_stat")
+
+    def with_phantom():
+        return orig() + [phantom]
+
+    monkeypatch.setattr(mod, "collect_names", with_phantom)
+    missing = mod.main()
+    assert phantom in missing
+
+
+def test_mesh_names_are_live_surfaces():
+    """MESH_NAMES cross-checks itself against the live config and
+    stats surfaces: naming a nonexistent knob/key raises, so a rename
+    cannot silently unpin the serving.md routing."""
+    mod = _load_check_docs()
+    names = mod.collect_names()
+    mesh = {n for k, n in names if k == "mesh surface"}
+    assert mesh == set(mod.MESH_NAMES)
+    live = {n for k, n in names if k != "mesh surface"}
+    assert mesh <= live
+
+
+def test_mesh_names_are_checked_against_serving_doc():
+    """The mesh kinds map to docs/serving.md alone — every MESH_NAMES
+    entry must appear there (the "Mesh sharding" section)."""
+    mod = _load_check_docs()
+    mesh_text = mod._docs_text(mod.MESH_DOCS)
+    for name in mod.MESH_NAMES:
+        assert name in mesh_text, name
+
+
 def test_integrity_names_are_live_surfaces():
     """INTEGRITY_NAMES cross-checks itself against the live config and
     stats surfaces: naming a nonexistent knob/key raises, so a rename

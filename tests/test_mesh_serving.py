@@ -1,0 +1,474 @@
+"""Mesh-sharded serving (tier-1, CPU, 8 virtual devices): the GSPMD
+``("batch", "model")`` mesh promotion of the inference engine
+(docs/serving.md "Mesh sharding").
+
+The certification matrix ISSUE 15 names: mesh (1, 1) bit-identical to
+the pre-mesh engine (outputs, statuses, the FULL stats() dict —
+greedy+sampled x spec on/off x int8 quantization), token-identity of
+request outputs across mesh shapes, compile counts still pinned at one
+per program under the mesh, the hlo_audit collective contract (zero
+collectives at a 1-sized model axis, all-reduce traffic once heads
+split), snapshot/restore + 1-replica-fleet identity with mesh-sharded
+engines, allocator integrity after mesh-sharded LRU churn — plus the
+old tp=2 shard_map decode smoke folded into a regular mesh test."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.models import GPTConfig, GPTLMHeadModel
+from apex_tpu.serving import (
+    EngineConfig,
+    FleetConfig,
+    FleetRouter,
+    InferenceEngine,
+    Request,
+    SamplingParams,
+    build_mesh,
+    expected_collectives,
+    validate_mesh_shape,
+)
+from apex_tpu.serving import mesh as mesh_lib
+from apex_tpu.utils.hlo_audit import (
+    assert_collective_contract,
+    collective_stats,
+)
+
+CONST_CLOCK = lambda: 0.0  # noqa: E731 — constant-clock stats compare
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (1, 8))))
+    return cfg, model, params
+
+
+def _config(mesh_shape=(1, 1), **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_prefill_len", 8)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("seed", 7)
+    return EngineConfig(mesh_shape=mesh_shape, **kw)
+
+
+def _mixed_requests(cfg, n=5, sampled=True):
+    """A seeded mixed workload: varied prompt lengths, greedy AND
+    sampled lanes (per-request keys make the draws mesh-invariant)."""
+    rr = np.random.RandomState(3)
+    out = []
+    for i in range(n):
+        sp = (SamplingParams(temperature=0.7, top_k=8, top_p=0.9)
+              if sampled and i % 2 else SamplingParams())
+        out.append(Request(
+            uid=f"r{i}", prompt=list(rr.randint(0, cfg.vocab_size, 7 + i)),
+            max_new_tokens=6 + (i % 3), sampling=sp))
+    return out
+
+
+def _serve(model, params, ecfg, requests, clock=CONST_CLOCK):
+    eng = InferenceEngine(model, params, ecfg, clock=clock)
+    for r in requests:
+        eng.add_request(r)
+    results = eng.run(return_status=True)
+    return eng, results
+
+
+# ---------------------------------------------------------------------------
+# config validation (the ISSUE 15 "small fix" satellite)
+# ---------------------------------------------------------------------------
+
+def test_mesh_shape_validation_named_errors():
+    for bad in ((0, 1), (1, 0), (1,), (1, 2, 3), "x1", (1.5, 2)):
+        with pytest.raises(ValueError, match="mesh_shape"):
+            _config(mesh_shape=bad)
+    # more devices than the backend has (tests run on 8 virtual CPUs)
+    with pytest.raises(ValueError, match="mesh_shape.*devices"):
+        _config(mesh_shape=(2, 8))
+    # a list normalizes to a tuple (fingerprint-stable)
+    assert _config(mesh_shape=[1, 2]).mesh_shape == (1, 2)
+
+
+def test_model_axis_must_divide_heads(tiny):
+    cfg, model, params = tiny
+    assert cfg.num_heads == 4
+    with pytest.raises(ValueError, match="num_heads"):
+        InferenceEngine(model, params, _config(mesh_shape=(1, 3)))
+    with pytest.raises(ValueError, match="num_heads"):
+        validate_mesh_shape((1, 8), num_heads=4)
+
+
+def test_mesh_kwarg_must_match_config(tiny):
+    _, model, params = tiny
+    with pytest.raises(ValueError, match="mesh_shape"):
+        InferenceEngine(model, params, _config(mesh_shape=(1, 2)),
+                        mesh=build_mesh((1, 1)))
+
+
+def test_pallas_flag_rejected_on_sharded_model_axis(tiny, monkeypatch):
+    _, model, params = tiny
+    monkeypatch.setenv("APEX_PAGED_ATTENTION_PALLAS", "1")
+    with pytest.raises(ValueError, match="APEX_PAGED_ATTENTION_PALLAS"):
+        InferenceEngine(model, params, _config(mesh_shape=(1, 2)))
+    # a 1-sized model axis is single-device: the flag stays legal
+    InferenceEngine(model, params, _config(mesh_shape=(1, 1)))
+
+
+# ---------------------------------------------------------------------------
+# mesh (1, 1) bit-identity to the pre-mesh engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [0, 2])
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_mesh11_bit_identity_matrix(tiny, monkeypatch, spec, quant):
+    """THE promotion cert: the default (1, 1) mesh engine — programs
+    compiled under the mesh, params/pool committed to (trivial)
+    NamedShardings, out_shardings pinned — must reproduce the pre-mesh
+    engine bit for bit: outputs, statuses, and the FULL stats() dict,
+    greedy+sampled lanes, speculation on/off, int8 quantization on/off.
+    The pre-mesh baseline is built by neutering the mesh layer (the
+    exact byte-identical pre-PR code path: no device_put, no
+    out_shardings)."""
+    cfg, model, params = tiny
+    reqs = _mixed_requests(cfg)
+    ecfg = _config(kv_quantization=quant, spec_tokens=spec)
+    mesh_eng, mesh_results = _serve(model, params, ecfg, reqs)
+
+    monkeypatch.setattr(mesh_lib, "shard_params",
+                        lambda mesh, params, pspec_fn=None: params)
+    monkeypatch.setattr(mesh_lib, "shard_cache", lambda mesh, cache: cache)
+    monkeypatch.setattr(mesh_lib, "program_out_shardings",
+                        lambda mesh, cache: None)
+    plain_eng, plain_results = _serve(model, params, ecfg, reqs)
+
+    assert {u: r.tokens for u, r in mesh_results.items()} \
+        == {u: r.tokens for u, r in plain_results.items()}
+    assert {u: r.status for u, r in mesh_results.items()} \
+        == {u: r.status for u, r in plain_results.items()}
+    assert mesh_eng.stats() == plain_eng.stats()
+
+
+# ---------------------------------------------------------------------------
+# token-identity across mesh shapes + pinned compile counts
+# ---------------------------------------------------------------------------
+
+def test_cross_mesh_token_identity(tiny):
+    """The same seeded trace at (1, 1), (1, 2) and (2, 2) must emit
+    identical token streams and statuses: greedy argmaxes and the
+    per-lane keyed draws are invariant to where the heads live (the
+    all-reduce changes summation order by ulps, not verdicts — pinned
+    here on fixed seeds, the same certified-per-backend posture as the
+    speculative greedy cert)."""
+    cfg, model, params = tiny
+    reqs = _mixed_requests(cfg, n=6)
+    baseline = None
+    for shape in ((1, 1), (1, 2), (2, 2)):
+        eng, results = _serve(model, params, _config(mesh_shape=shape),
+                              reqs)
+        got = {u: (r.tokens, r.status) for u, r in results.items()}
+        assert eng.stats()["mesh_model_axis"] == shape[1]
+        if baseline is None:
+            baseline = got
+        else:
+            assert got == baseline, f"mesh {shape} diverged"
+
+
+def test_mesh_compile_counts_pinned(tiny):
+    """One prefill + one decode compilation for the engine's lifetime
+    UNDER THE MESH: the out_shardings pin keeps the returned pool in
+    the committed layout, so no second compile ever triggers — across
+    multiple admission waves, block growth, and drained restarts."""
+    cfg, model, params = tiny
+    eng = InferenceEngine(model, params,
+                          _config(mesh_shape=(1, 2), num_blocks=16,
+                                  enable_prefix_caching=True))
+    rr = np.random.RandomState(5)
+    for wave in range(3):
+        for i in range(4):
+            eng.add_request(Request(
+                uid=f"w{wave}r{i}",
+                prompt=list(rr.randint(0, cfg.vocab_size, 5 + 2 * i)),
+                max_new_tokens=7))
+        eng.run()
+    s = eng.stats()
+    assert s["prefill_compilations"] == 1, s
+    assert s["decode_compilations"] == 1, s
+
+
+# ---------------------------------------------------------------------------
+# the hlo_audit collective contract
+# ---------------------------------------------------------------------------
+
+def test_collective_contract_mesh11_zero(tiny):
+    cfg, model, params = tiny
+    eng = InferenceEngine(model, params, _config(mesh_shape=(1, 1)))
+    audited = eng.audit_collectives()
+    assert set(audited) == {"prefill", "decode"}
+    for stats in audited.values():
+        assert stats["total"]["ops"] == 0
+
+
+def test_collective_contract_mesh12_allreduce(tiny):
+    """Heads split -> the Megatron-via-GSPMD layout must show exactly
+    the reduction traffic the layout predicts: one all-reduce per
+    row-parallel projection (attn_out + mlp_out, per layer) in every
+    program — prefill, decode scan, and speculative verify — and no
+    all-to-all anywhere."""
+    cfg, model, params = tiny
+    eng = InferenceEngine(model, params, _config(mesh_shape=(1, 2)))
+    audited = eng.audit_collectives()     # raises on contract violation
+
+    def reductions(stats):
+        # spelling-agnostic: XLA may lower one all-reduce as a
+        # reduce-scatter + all-gather pair (the hlo_audit round-5
+        # lesson); both satisfy the reduction contract
+        return stats["all-reduce"]["ops"] + stats["reduce-scatter"]["ops"]
+
+    for prog, stats in audited.items():
+        assert reductions(stats) >= 2 * cfg.num_layers, (prog, stats)
+        assert stats["all-to-all"]["ops"] == 0, (prog, stats)
+    # the verify program (the decode slot under speculation) holds the
+    # same contract
+    spec_eng = InferenceEngine(model, params,
+                               _config(mesh_shape=(1, 2), spec_tokens=3))
+    audited = spec_eng.audit_collectives()
+    assert "verify" in audited
+    assert reductions(audited["verify"]) >= 2 * cfg.num_layers
+    # and the audit's AOT lowering must not have perturbed the pinned
+    # jit call caches
+    assert eng.stats()["prefill_compilations"] == 0
+    assert eng.stats()["decode_compilations"] == 0
+
+
+def test_assert_collective_contract_unit():
+    zero = collective_stats("")
+    assert_collective_contract(zero, exact_total_ops=0)
+    ar = collective_stats(
+        "  %r = f32[8,16] all-reduce(f32[8,16] %x), replica_groups={}\n")
+    with pytest.raises(AssertionError, match="exactly 0"):
+        assert_collective_contract(ar, exact_total_ops=0)
+    assert_collective_contract(ar, min_ops={"all-reduce": 1},
+                               forbidden=("all-to-all",))
+    with pytest.raises(AssertionError, match="floors"):
+        assert_collective_contract(zero, min_ops={"all-reduce": 1})
+    # the reduce-scatter + all-gather spelling satisfies the same
+    # reduction contract through alt_min_ops
+    rsag = collective_stats(
+        "  %a = f32[4,16] reduce-scatter(f32[8,16] %x), dimensions={0}\n"
+        "  %b = f32[8,16] all-gather(f32[4,16] %a), dimensions={0}\n")
+    assert_collective_contract(rsag, min_ops={"all-reduce": 1},
+                               alt_min_ops={"reduce-scatter": 1,
+                                            "all-gather": 1})
+    with pytest.raises(AssertionError, match="forbidden"):
+        assert_collective_contract(
+            collective_stats("  %c = f32[8] all-to-all(f32[8] %x)\n"),
+            forbidden=("all-to-all",))
+
+
+def test_expected_collectives_shapes():
+    assert expected_collectives((1, 1)) == {"exact_total_ops": 0}
+    assert expected_collectives((4, 1)) == {"exact_total_ops": 0}
+    c = expected_collectives((1, 2))
+    assert c["min_ops"] == {"all-reduce": 1}
+    assert "all-to-all" in c["forbidden"]
+
+
+# ---------------------------------------------------------------------------
+# snapshot/restore + fleet identity with mesh-sharded engines
+# ---------------------------------------------------------------------------
+
+def test_mesh_snapshot_restore_bit_identity(tiny):
+    """A (1, 2) engine snapshotted mid-run (JSON round-trip — the real
+    wire) and restored into a fresh (1, 2) engine must finish
+    bit-identically to the uninterrupted sharded run: the records are
+    host-side and layout-free, and re-prefill re-derives the sharded
+    pool."""
+    cfg, model, params = tiny
+    reqs = _mixed_requests(cfg, n=4)
+    ecfg = _config(mesh_shape=(1, 2), enable_prefix_caching=True)
+    _, uninterrupted = _serve(model, params, ecfg, reqs)
+
+    eng = InferenceEngine(model, params, ecfg, clock=CONST_CLOCK)
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(3):
+        eng.step()
+    snap = json.loads(json.dumps(eng.snapshot()))
+    partial = eng.pop_results()
+
+    restored = InferenceEngine(model, params, ecfg, clock=CONST_CLOCK)
+    restored.restore(snap)
+    finishing = restored.run(return_status=True)
+    combined = {u: r.tokens for u, r in {**partial, **finishing}.items()}
+    assert combined == {u: r.tokens for u, r in uninterrupted.items()}
+    restored.check_allocator_integrity()
+
+
+def test_mesh_shape_is_restore_identity(tiny):
+    """mesh_shape joins the restore-fingerprint identity set: a
+    (1, 1) snapshot refuses to restore into a (1, 2) engine (and the
+    refusal names the knob) — but restores cleanly across EQUAL
+    meshes, tuple-vs-JSON-list normalization included."""
+    cfg, model, params = tiny
+    eng = InferenceEngine(model, params, _config(mesh_shape=(1, 1)))
+    snap = json.loads(json.dumps(eng.snapshot()))
+    other = InferenceEngine(model, params, _config(mesh_shape=(1, 2)))
+    with pytest.raises(ValueError, match="mesh_shape"):
+        other.restore(snap)
+    same = InferenceEngine(model, params, _config(mesh_shape=(1, 1)))
+    same.restore(snap)      # tuple fingerprint == round-tripped list
+
+
+def test_fleet_one_replica_mesh_identity(tiny):
+    """The PR 12 fleet cert extended to sharded replicas: a 1-replica
+    fleet whose engine is mesh-(1, 2)-sharded serves the trace
+    identically to the bare (1, 2) engine (outputs + statuses), and
+    the replica's allocator survives the run intact."""
+    cfg, model, params = tiny
+    reqs = _mixed_requests(cfg, n=5)
+    ecfg = _config(mesh_shape=(1, 2), enable_prefix_caching=True)
+    _, bare = _serve(model, params, ecfg, reqs)
+
+    fleet = FleetRouter(model, params, ecfg,
+                        FleetConfig(num_replicas=1), clock=CONST_CLOCK)
+    for r in reqs:
+        fleet.add_request(Request(
+            uid=r.uid, prompt=list(r.prompt),
+            max_new_tokens=r.max_new_tokens, sampling=r.sampling))
+    fleet_results = fleet.run(return_status=True)
+    assert {u: (r.tokens, r.status) for u, r in fleet_results.items()} \
+        == {u: (r.tokens, r.status) for u, r in bare.items()}
+    assert fleet.replicas[0].engine.config.mesh_shape == (1, 2)
+    fleet.replicas[0].engine.check_allocator_integrity()
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded memory tiers + LRU churn
+# ---------------------------------------------------------------------------
+
+def test_mesh_spill_reserve_token_identity(tiny):
+    """The host spill tier under a sharded pool: spilled payloads read
+    out of (and upload back into) the mesh-sharded pools, and a
+    flushed-then-re-served trace stays token-identical — the spill
+    path is layout-free because payloads move as host numpy."""
+    cfg, model, params = tiny
+    from apex_tpu.serving import kv_block_bytes
+    blk = kv_block_bytes(cfg.num_layers, 4, cfg.num_heads,
+                         cfg.hidden_size // cfg.num_heads,
+                         dtype=jnp.float32)
+    ecfg = _config(mesh_shape=(1, 2), max_batch=2, num_blocks=8,
+                   kv_dtype=jnp.float32, enable_prefix_caching=True,
+                   spill_max_bytes=64 * blk)
+    eng = InferenceEngine(model, params, ecfg)
+    rr = np.random.RandomState(11)
+    prompts = [list(rr.randint(0, cfg.vocab_size, 9)) for _ in range(3)]
+
+    def serve(tag):
+        for i, p in enumerate(prompts):
+            eng.add_request(Request(uid=f"{tag}{i}", prompt=p,
+                                    max_new_tokens=4))
+        return eng.run()
+
+    first = serve("a")
+    eng.allocator.flush_evictable()
+    second = serve("b")
+    assert all(second[f"b{i}"] == first[f"a{i}"]
+               for i in range(len(prompts)))
+    s = eng.stats()
+    assert s["spill_hits"] > 0, s
+    eng.check_allocator_integrity()
+
+
+def test_mesh_lru_churn_allocator_integrity(tiny):
+    """check_allocator_integrity after mesh-sharded LRU churn: a tight
+    pool, prefix caching, overlapping prompts, repeated waves —
+    eviction, revival, preemption and CoW all run against the sharded
+    pool, and the exact refcount/ledger audit must hold at the end."""
+    cfg, model, params = tiny
+    ecfg = _config(mesh_shape=(1, 2), num_blocks=12, max_batch=3,
+                   enable_prefix_caching=True)
+    eng = InferenceEngine(model, params, ecfg)
+    rr = np.random.RandomState(13)
+    shared = list(rr.randint(0, cfg.vocab_size, 8))
+    for wave in range(3):
+        for i in range(4):
+            tail = list(rr.randint(0, cfg.vocab_size, 3 + i))
+            eng.add_request(Request(uid=f"c{wave}_{i}",
+                                    prompt=shared + tail,
+                                    max_new_tokens=5))
+        eng.run()
+        eng.check_allocator_integrity()
+    assert eng.stats()["prefix_hit_blocks"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the folded tp=2 decode smoke (now a regular mesh test)
+# ---------------------------------------------------------------------------
+
+def test_tp2_paged_decode_mesh(tiny):
+    """The old bespoke shard_map tp=2 smoke, folded into the mesh
+    path: decode attention + the row-parallel output projection under
+    NamedSharding annotations and plain jit — GSPMD inserts the
+    Megatron psum itself (asserted from the compiled HLO), and the
+    result matches the unsharded computation."""
+    from apex_tpu.ops.flash_attention import paged_decode_attention
+
+    B, H, D, N, bs, M = 2, 4, 8, 8, 4, 3
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, D).astype("f4"))
+    k_pages = jnp.asarray(rng.randn(N, bs, H, D).astype("f4"))
+    v_pages = jnp.asarray(rng.randn(N, bs, H, D).astype("f4"))
+    w_out = jnp.asarray(rng.randn(H * D, 16).astype("f4") * 0.1)
+    tables = jnp.asarray([[0, 2, 5], [1, 3, 4]], jnp.int32)
+    ctx = jnp.asarray([9, 6], jnp.int32)
+    scale = 1.0 / np.sqrt(D)
+
+    def attend_project(q, kp, vp, w):
+        out = paged_decode_attention(q, kp, vp, tables, ctx, scale)
+        return out.reshape(B, -1) @ w       # GSPMD all-reduces this
+
+    ref = attend_project(q, k_pages, v_pages, w_out)
+
+    mesh = build_mesh((1, 2))
+    shard = lambda x, spec: jax.device_put(      # noqa: E731
+        x, NamedSharding(mesh, spec))
+    jitted = jax.jit(attend_project,
+                     out_shardings=NamedSharding(mesh, P()))
+    args = (shard(q, P(None, "model")),
+            shard(k_pages, P(None, None, "model")),
+            shard(v_pages, P(None, None, "model")),
+            # head-major flat rows: rank r's W_out rows stay contiguous
+            shard(w_out, P("model", None)))
+    got = jitted(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+    stats = collective_stats(jitted.lower(*args).compile().as_text())
+    assert stats["all-reduce"]["ops"] >= 1 \
+        or (stats["reduce-scatter"]["ops"] >= 1
+            and stats["all-gather"]["ops"] >= 1), stats
+
+
+# ---------------------------------------------------------------------------
+# surface
+# ---------------------------------------------------------------------------
+
+def test_mesh_stats_and_fingerprint_surface(tiny):
+    cfg, model, params = tiny
+    eng = InferenceEngine(model, params, _config(mesh_shape=(1, 2)))
+    s = eng.stats()
+    assert s["mesh_devices"] == 2
+    assert s["mesh_model_axis"] == 2
+    fp = eng._config_fingerprint()
+    assert fp["mesh_shape"] == [1, 2]       # JSON-stable list form
+    assert tuple(eng.mesh.axis_names) == ("batch", "model")

@@ -1,7 +1,8 @@
 """apex_tpu.serving tests (tier-1, CPU): paged KV-cache correctness,
 decode parity vs the full-sequence forward, continuous batching with
-staggered arrivals/EOS under the two-program compilation contract,
-sampling determinism, and a tp=2 decode smoke."""
+staggered arrivals/EOS under the two-program compilation contract, and
+sampling determinism. (The old tp=2 shard_map decode smoke folded into
+the mesh matrix — tests/test_mesh_serving.py.)"""
 
 import jax
 import jax.numpy as jnp
@@ -923,52 +924,3 @@ def test_sampling_top_p_renormalizes_over_top_k_survivors():
         assert tok == 0
 
 
-# ---------------------------------------------------------------------------
-# tensor-parallel decode smoke (tp=2, heads sharded over the mesh)
-# ---------------------------------------------------------------------------
-
-def test_tp2_paged_decode_smoke():
-    """Decode attention + the row-parallel output projection under a
-    2-way tensor mesh (heads sharded, partial products psum'd — the
-    Megatron decomposition) must match the unsharded computation."""
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from apex_tpu.ops.flash_attention import paged_decode_attention
-
-    B, H, D, N, bs, M = 2, 4, 8, 8, 4, 3
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(B, H, D).astype("f4"))
-    k_pages = jnp.asarray(rng.randn(N, bs, H, D).astype("f4"))
-    v_pages = jnp.asarray(rng.randn(N, bs, H, D).astype("f4"))
-    w_out = jnp.asarray(rng.randn(H * D, 16).astype("f4") * 0.1)
-    tables = jnp.asarray([[0, 2, 5], [1, 3, 4]], jnp.int32)
-    ctx = jnp.asarray([9, 6], jnp.int32)
-    scale = 1.0 / np.sqrt(D)
-
-    def attend_project(q, kp, vp, w):
-        out = paged_decode_attention(q, kp, vp, tables, ctx, scale)
-        y = out.reshape(B, -1) @ w          # local heads' slice of W_out
-        return jax.lax.psum(y, "tensor")    # row-parallel reduction
-
-    ref = (paged_decode_attention(q, k_pages, v_pages, tables, ctx, scale)
-           .reshape(B, -1) @ w_out)
-
-    mesh = jax.make_mesh((2,), ("tensor",))
-    # heads shard over the mesh; W_out rows shard to match (head-major
-    # flat layout keeps rank r's rows contiguous)
-    w_sharded = w_out.reshape(H, D, 16)
-    got = jax.jit(shard_map(
-        lambda q, kp, vp, w: attend_project(q, kp, vp,
-                                            w.reshape(-1, w.shape[-1])),
-        mesh=mesh,
-        in_specs=(P(None, "tensor"), P(None, None, "tensor"),
-                  P(None, None, "tensor"), P("tensor")),
-        out_specs=P(),
-        check_rep=False,
-    ))(q, k_pages, v_pages, w_sharded)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=1e-4, rtol=1e-4)

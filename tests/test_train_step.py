@@ -546,6 +546,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
         "serving_tiny_fleet_kill_goodput_tok_per_sec",
         "serving_tiny_integrity_sdc_detection_latency_ticks",
+        "serving_tiny_mesh_decode_tokens_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -644,6 +645,30 @@ def test_bench_smoke_mode_every_section_rc0():
     assert math.isfinite(it["value"]) and it["value"] >= 0, it
     assert it["sdc_suspect_tick"] >= it["sdc_first_corrupt_tick"], it
     assert math.isfinite(it["vs_baseline"]) and it["vs_baseline"] > 0
+    # the mesh arm (docs/serving.md "Mesh sharding") must prove the
+    # pod-scale promotion story: (1,1) bit-identical to the pre-mesh
+    # engine, greedy outputs token-identical across mesh shapes,
+    # compile counts pinned at one per program under BOTH meshes, and
+    # the collective contract (zero at (1,1), all-reduce traffic in
+    # every program at (1,2)) — a silently-single-device arm would be
+    # a quiet scale-up lie
+    ms = [r for r in records
+          if r.get("metric") == "serving_tiny_mesh_decode_tokens_per_sec"][0]
+    assert ms["mesh11_bit_identical"] is True, ms
+    assert ms["cross_mesh_token_identical"] is True, ms
+    for arm_name in ("mesh_1x1", "mesh_1x2"):
+        arm = ms["arms"][arm_name]
+        assert arm["prefill_compilations"] == 1, ms
+        assert arm["decode_compilations"] == 1, ms
+    assert all(v == 0 for v in
+               ms["arms"]["mesh_1x1"]["collective_ops"].values()), ms
+    # reduction_ops, not the raw all-reduce count: XLA may spell one
+    # all-reduce as a reduce-scatter + all-gather pair (the hlo_audit
+    # round-5 lesson) and both spellings satisfy the contract
+    assert all(v >= 1 for v in
+               ms["arms"]["mesh_1x2"]["reduction_ops"].values()), ms
+    assert math.isfinite(ms["value"]) and ms["value"] > 0, ms
+    assert math.isfinite(ms["vs_baseline"]) and ms["vs_baseline"] > 0, ms
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -662,7 +687,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_serving_kv_memory",
         "bench_serving_fleet", "bench_serving_integrity",
-        "bench_train_step", "bench_obs_pipeline",
+        "bench_serving_mesh", "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

@@ -36,6 +36,17 @@ ROBUSTNESS_DOCS = ("docs/robustness.md",)
 OBS_KINDS = ("trace event type", "recorder event kind", "metric")
 FLEET_KINDS = ("FleetConfig field", "fleet stats() key")
 INTEGRITY_KINDS = ("integrity surface",)
+MESH_KINDS = ("mesh surface",)
+MESH_DOCS = ("docs/serving.md",)
+# the pod-scale mesh surface (knob + stats keys) must be named in the
+# "Mesh sharding" doc itself, docs/serving.md — same discipline as the
+# integrity surface: each name is cross-checked against the live
+# config/stats surfaces, so a renamed knob breaks the lint instead of
+# silently unpinning it.
+MESH_NAMES = (
+    "mesh_shape",
+    "mesh_devices", "mesh_model_axis",
+)
 # the data-integrity surface (knobs + counters) must be named in the
 # "Data integrity" doc itself, docs/robustness.md — not merely
 # somewhere in the combined serving text. Each name listed here is
@@ -120,6 +131,15 @@ def collect_names():
                 "live EngineConfig/FleetConfig field or stats() key — "
                 "update tools/check_docs.py")
         names.append(("integrity surface", n))
+    # the mesh surface: same liveness discipline, routed to the
+    # "Mesh sharding" doc (docs/serving.md) specifically
+    for n in MESH_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"MESH_NAMES lists {n!r}, which is no longer a live "
+                "EngineConfig field or stats() key — update "
+                "tools/check_docs.py")
+        names.append(("mesh surface", n))
     return names
 
 
@@ -128,6 +148,7 @@ def main():
     obs_text = _docs_text(OBS_DOCS)
     fleet_text = _docs_text(FLEET_DOCS)
     robustness_text = _docs_text(ROBUSTNESS_DOCS)
+    mesh_text = _docs_text(MESH_DOCS)
     missing = []
     for kind, name in collect_names():
         if kind in OBS_KINDS:
@@ -136,6 +157,8 @@ def main():
             text, where = fleet_text, FLEET_DOCS
         elif kind in INTEGRITY_KINDS:
             text, where = robustness_text, ROBUSTNESS_DOCS
+        elif kind in MESH_KINDS:
+            text, where = mesh_text, MESH_DOCS
         else:
             text, where = serving_text, SERVING_DOCS
         if name not in text:
