@@ -4,8 +4,12 @@ Paged KV-cache (:mod:`~apex_tpu.serving.kv_cache`), continuous-batching
 prefill/decode engine (:mod:`~apex_tpu.serving.engine`), jit-stable
 sampling (:mod:`~apex_tpu.serving.sampling`), the GSPMD mesh layout
 that shards an engine over a ``("batch", "model")`` device mesh
-(:mod:`~apex_tpu.serving.mesh`), and the crash-tolerant
-multi-replica fleet router (:mod:`~apex_tpu.serving.fleet`); design
+(:mod:`~apex_tpu.serving.mesh`), the crash-tolerant
+multi-replica fleet router (:mod:`~apex_tpu.serving.fleet`), and the
+out-of-process replica runtime — the framed stdio RPC layer
+(:mod:`~apex_tpu.serving.wire`), the parent-side child handle
+(:mod:`~apex_tpu.serving.process_replica`), and the child entrypoint
+(:mod:`~apex_tpu.serving.replica_worker`); design
 notes in docs/serving.md and docs/fleet.md. The training-side capability surface (amp dtype
 policy, the flash-attention kernel family, the GPT/BERT models) is
 reused, not duplicated: the cache stores in the amp compute dtype, the
@@ -32,6 +36,13 @@ from apex_tpu.serving.fleet import (  # noqa: F401
     FleetConfig,
     FleetFailedError,
     FleetRouter,
+)
+from apex_tpu.serving.process_replica import (  # noqa: F401
+    ProcessReplica,
+    RemoteEngineError,
+    ReplicaUnavailableError,
+    gpt_model_spec,
+    params_checksum,
 )
 from apex_tpu.serving.mesh import (  # noqa: F401
     MESH_AXES,

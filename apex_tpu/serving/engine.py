@@ -3447,6 +3447,42 @@ class InferenceEngine:
                                         + self.allocator.num_cached),
         }
 
+    # the replica-surface discriminator a router reads to know whether
+    # this replica lives in its own OS process (ProcessReplica reports
+    # "process"); a class attribute so even a dead slot still answers
+    mode = "in_process"
+
+    @property
+    def block_weight(self) -> float:
+        """The per-block resident-cost weight (1.0 unquantized; the
+        packed fraction under KV quantization) — part of the narrow
+        replica surface so the router's door throttle can price tenant
+        block charges without reaching into engine internals (which a
+        process replica could not serve)."""
+        return float(self._block_weight)
+
+    @property
+    def queue_depth(self) -> int:
+        """``len(waiting)`` as a surface method — the router's
+        ``stats()`` aggregate reads this, not the queue object."""
+        return len(self.waiting)
+
+    @property
+    def active_slot_count(self) -> int:
+        """Occupied decode lanes — same narrow-surface rationale as
+        :attr:`queue_depth`."""
+        return sum(s is not None for s in self.slots)
+
+    def tenant_charge(self, tenant: str) -> int:
+        """The tenant's resident-block charge (allocator attribution),
+        surfaced for the router's per-tenant door throttle."""
+        return self.allocator.tenant_charge(tenant)
+
+    def tenant_depth(self, tenant: str) -> int:
+        """The tenant's waiting-queue depth, surfaced for the router's
+        per-tenant door throttle."""
+        return self.waiting.tenant_depth(tenant)
+
     def probe_prefix(self, hashes: Sequence[str]) -> int:
         """How many leading blocks of a hash chain this engine could
         serve WITHOUT recompute: the device prefix index's longest

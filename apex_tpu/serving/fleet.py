@@ -110,6 +110,11 @@ from apex_tpu.serving.kv_cache import (
     seq_block_hashes,
 )
 from apex_tpu.serving.mesh import build_mesh
+from apex_tpu.serving.process_replica import (
+    ProcessReplica,
+    ReplicaUnavailableError,
+    params_checksum,
+)
 from apex_tpu.utils.integrity import (
     IntegrityError,
     seal_record,
@@ -191,6 +196,37 @@ class FleetConfig:
     # (speculative span boundaries are schedule-dependent). None = off
     # (the default; the cross-check consumes real verifier capacity).
     sdc_check_interval_ticks: Optional[int] = None
+    # -- process replicas (docs/fleet.md, "Process replicas") ----------
+    # "in_process" drives InferenceEngine objects in the router's own
+    # process (the default, unchanged); "process" runs each replica as
+    # a child OS process behind ProcessReplica — same surface, real
+    # isolation, real SIGKILL. Process mode requires FleetRouter's
+    # ``model_spec`` (the child rebuilds the weights from it and the
+    # boot handshake proves they match).
+    replica_mode: str = "in_process"
+    # per-RPC response deadline for process replicas; an overrun marks
+    # the child unresponsive and drives the normal failover path
+    # (generous by default: a child's FIRST step compiles the engine
+    # programs)
+    rpc_timeout_s: float = 300.0
+    # resends of one RPC (same id — the worker dedupes) after a torn/
+    # rotted response frame, before the replica is declared dead
+    rpc_retries: int = 2
+    # -- elastic autoscaling (docs/fleet.md, "Autoscaler") -------------
+    # the control signal is mean queue depth per alive replica, read
+    # each router tick. Above the high watermark for
+    # ``autoscale_patience`` CONSECUTIVE ticks -> spawn one replica
+    # (prefix-cache warmed from the survivors); below the low
+    # watermark as long -> retire one via drain_replica(retire=True).
+    # None disables the corresponding direction (both None: no
+    # autoscaler at all — certified bit-identical to never setting
+    # them). Hysteresis = the patience debounce + the watermark gap
+    # (validated: high > low) + min/max bounds.
+    autoscale_high_watermark: Optional[float] = None
+    autoscale_low_watermark: Optional[float] = None
+    autoscale_patience: int = 3
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: Optional[int] = None
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -225,17 +261,53 @@ class FleetConfig:
                 f"sdc_check_interval_ticks must be >= 1 (or None for "
                 f"no cross-checking), got "
                 f"{self.sdc_check_interval_ticks}")
+        if self.replica_mode not in ("in_process", "process"):
+            raise ValueError(
+                f"replica_mode must be 'in_process' or 'process', got "
+                f"{self.replica_mode!r}")
+        if self.rpc_timeout_s <= 0:
+            raise ValueError(
+                f"rpc_timeout_s must be > 0, got {self.rpc_timeout_s}")
+        if self.rpc_retries < 0:
+            raise ValueError(
+                f"rpc_retries must be >= 0, got {self.rpc_retries}")
+        hi, lo = (self.autoscale_high_watermark,
+                  self.autoscale_low_watermark)
+        if hi is not None and lo is not None and not hi > lo:
+            raise ValueError(
+                f"autoscale_high_watermark ({hi}) must be strictly "
+                f"above autoscale_low_watermark ({lo}) — the gap is "
+                "half the anti-flap hysteresis")
+        if self.autoscale_patience < 1:
+            raise ValueError(
+                f"autoscale_patience must be >= 1, got "
+                f"{self.autoscale_patience}")
+        if self.autoscale_min_replicas < 1:
+            raise ValueError(
+                f"autoscale_min_replicas must be >= 1, got "
+                f"{self.autoscale_min_replicas}")
+        if (self.autoscale_max_replicas is not None
+                and self.autoscale_max_replicas
+                < self.autoscale_min_replicas):
+            raise ValueError(
+                f"autoscale_max_replicas "
+                f"({self.autoscale_max_replicas}) must be >= "
+                f"autoscale_min_replicas "
+                f"({self.autoscale_min_replicas})")
 
 
 @dataclasses.dataclass
 class _Replica:
-    """One replica slot: the engine plus the router's health view."""
+    """One replica slot: the engine plus the router's health view.
+    ``mode`` is recorded at spawn so a dead slot (engine dropped)
+    still reports what it was."""
 
     engine: Optional[InferenceEngine]
     alive: bool = True
     stall_streak: int = 0
     routed: int = 0
     error: Optional[str] = None
+    mode: str = "in_process"
 
 
 class FleetRouter:
@@ -259,7 +331,9 @@ class FleetRouter:
                  fleet_config: Optional[FleetConfig] = None, *,
                  drafters: Optional[Sequence] = None,
                  faults: Optional[Sequence] = None,
-                 clock=None, obs=None):
+                 clock=None, obs=None,
+                 model_spec: Optional[Dict] = None,
+                 child_clock: Optional[Dict] = None):
         self.model = model
         self.params = params
         self.engine_config = engine_config
@@ -279,6 +353,45 @@ class FleetRouter:
                           else [None] * n)
         self._faults = (list(faults) if faults is not None
                         else [None] * n)
+        # -- process-mode wiring (docs/fleet.md, "Process replicas") ----
+        # model_spec: how a child rebuilds (model, params); the router
+        # still holds its own copies (placement hashing, SDC replay
+        # verification, the respawn checksum handshake all read them).
+        # child_clock: the CHILD engines' clock spec — a parent lambda
+        # cannot cross a process boundary, so a custom router clock
+        # must state what the children run on.
+        self._model_spec = model_spec
+        self._child_clock = child_clock
+        self._params_checksum: Optional[str] = None
+        if self.config.replica_mode == "process":
+            if model_spec is None:
+                raise ValueError(
+                    "replica_mode='process' requires model_spec (see "
+                    "serving.process_replica.gpt_model_spec): the "
+                    "child must be able to rebuild the weights")
+            if any(d is not None for d in self._drafters):
+                raise ValueError(
+                    "custom drafter objects cannot cross the process "
+                    "boundary; children build the default NgramDrafter "
+                    "from EngineConfig.spec_tokens")
+            if clock is not None and child_clock is None:
+                raise ValueError(
+                    "replica_mode='process' with a custom clock needs "
+                    "child_clock (e.g. {'kind': 'constant', 't': 0.0})"
+                    " — the children cannot inherit a parent lambda")
+            self._params_checksum = params_checksum(params)
+        else:
+            if child_clock is not None:
+                raise ValueError(
+                    "child_clock is only meaningful with "
+                    "replica_mode='process'")
+            for plan in self._faults:
+                if any(s.site == "wire"
+                       for s in getattr(plan, "specs", ()) or ()):
+                    raise ValueError(
+                        "'wire' fault sites need "
+                        "replica_mode='process': an in-process "
+                        "replica has no frame path to attack")
         # ONE GSPMD mesh, threaded through every replica (and every
         # respawn): replicas of a mesh-sharded engine are mesh-sharded
         # replicas (docs/serving.md "Mesh sharding") — equal mesh +
@@ -342,6 +455,13 @@ class FleetRouter:
         self._num_refused_imports = 0
         self._num_sdc_checks = 0
         self._num_sdc_suspects = 0
+        # -- process replicas + autoscaler ------------------------------
+        self._num_spawned = 0
+        self._num_retired = 0
+        self._num_rpc_retries = 0
+        self._num_rpc_timeouts = 0
+        self._autoscale_hi_streak = 0
+        self._autoscale_lo_streak = 0
         self._sdc_enabled = \
             self.config.sdc_check_interval_ticks is not None
         self._sdc_arrivals: Dict[str, int] = {}
@@ -350,10 +470,29 @@ class FleetRouter:
         self._sdc_seq = 0
 
     def _spawn(self, idx: int) -> _Replica:
+        if self.config.replica_mode == "process":
+            eng = ProcessReplica(
+                self.engine_config, self._model_spec,
+                faults=self._faults[idx],
+                clock_spec=self._child_clock,
+                rpc_timeout_s=self.config.rpc_timeout_s,
+                rpc_retries=self.config.rpc_retries,
+                expect_params_checksum=self._params_checksum,
+                on_retry=self._note_rpc_retry,
+                on_timeout=lambda i=idx: self._note_rpc_timeout(i))
+            return _Replica(engine=eng, mode="process")
         return _Replica(engine=InferenceEngine(
             self.model, self.params, self.engine_config,
             drafter=self._drafters[idx], faults=self._faults[idx],
-            clock=self._clock, mesh=self.mesh))
+            clock=self._clock, mesh=self.mesh), mode="in_process")
+
+    def _note_rpc_retry(self) -> None:
+        self._num_rpc_retries += 1
+
+    def _note_rpc_timeout(self, idx: int) -> None:
+        self._num_rpc_timeouts += 1
+        if self._obs is not None:
+            self._obs.record("rpc_timeout", replica=idx)
 
     # -- placement ---------------------------------------------------------
 
@@ -440,7 +579,7 @@ class FleetRouter:
         t = request.tenant
         alive = self._alive()
         if q.max_resident_blocks is not None:
-            weight = (alive[0][1].engine._block_weight if alive else 1.0)
+            weight = (alive[0][1].engine.block_weight if alive else 1.0)
             worst = weight * blocks_needed(
                 len(request.prompt) + request.max_new_tokens,
                 self.engine_config.block_size)
@@ -453,7 +592,7 @@ class FleetRouter:
             # worst case must fit the fleet cap (the engine-level
             # quota holds an over-charge tenant at admission instead;
             # a fleet door has no queue to hold in, so it sheds)
-            charge = sum(rep.engine.allocator.tenant_charge(t)
+            charge = sum(rep.engine.tenant_charge(t)
                          for _, rep in alive)
             if charge + worst > q.max_resident_blocks + 1e-9:
                 return (f"holds {charge:.2f} resident block-units "
@@ -461,7 +600,7 @@ class FleetRouter:
                         f"case {worst:g} would break "
                         f"max_resident_blocks={q.max_resident_blocks}")
         if q.max_waiting is not None:
-            depth = sum(rep.engine.waiting.tenant_depth(t)
+            depth = sum(rep.engine.tenant_depth(t)
                         for _, rep in alive)
             if depth >= q.max_waiting:
                 return (f"already holds {depth} waiting entries across "
@@ -564,8 +703,17 @@ class FleetRouter:
 
     @property
     def has_work(self) -> bool:
-        return any(rep.alive and rep.engine is not None
-                   and rep.engine.has_work for rep in self.replicas)
+        for rep in self.replicas:
+            if not (rep.alive and rep.engine is not None):
+                continue
+            try:
+                if rep.engine.has_work:
+                    return True
+            except ReplicaUnavailableError:
+                # a dead process child IS work: the next step() runs
+                # its failover (re-homing everything it owned)
+                return True
+        return False
 
     def step(self) -> bool:
         """One fleet tick: step every alive replica that holds work
@@ -580,10 +728,13 @@ class FleetRouter:
             rep = self.replicas[i]
             if not rep.alive or rep.engine is None:
                 continue
-            if not rep.engine.has_work:
-                rep.stall_streak = 0
-                continue
             try:
+                # has_work is inside the containment on purpose: for a
+                # process replica it is an RPC, and a SIGKILLed child
+                # surfaces ReplicaUnavailableError right here
+                if not rep.engine.has_work:
+                    rep.stall_streak = 0
+                    continue
                 p = rep.engine.step()
             except Exception as e:  # replica crash containment: any
                 # escape — SimulatedCrash, CacheOutOfBlocks, a real
@@ -600,6 +751,7 @@ class FleetRouter:
                     self._fail_replica(i, "no-progress stall")
                     progressed = True
         self._drain_outputs()
+        self._autoscale_tick()
         self._maybe_sdc_check()
         return progressed
 
@@ -640,13 +792,18 @@ class FleetRouter:
         return out
 
     def _drain_outputs(self) -> None:
-        for _, rep in self._alive():
+        for i, rep in self._alive():
             # re-check at use time: draining one replica can RETIRE
             # another mid-loop (an SDC verdict intercepted in its
             # results fails the diverging owner, whose engine may
             # already sit later in this snapshot of the alive list)
             if rep.alive and rep.engine is not None:
-                self._drain_replica_outputs(rep.engine)
+                try:
+                    self._drain_replica_outputs(rep.engine)
+                except ReplicaUnavailableError as e:
+                    # a process child died between step and drain —
+                    # same containment as a step()-time crash
+                    self._fail_replica(i, f"{type(e).__name__}: {e}")
 
     def _drain_replica_outputs(self, eng: InferenceEngine) -> None:
         for uid, tok, last in eng.pop_stream_events():
@@ -904,6 +1061,108 @@ class FleetRouter:
                 # the owner half still stands
                 c["first_verifier"] = None
 
+    # -- elastic autoscaling (docs/fleet.md, "Autoscaler") -----------------
+
+    def _autoscale_tick(self) -> None:
+        """One control-loop tick, run every router tick after the
+        drain: read the signal (mean queue depth per alive replica —
+        pure ``load()`` reads, so a disabled or never-firing
+        autoscaler perturbs nothing, which is the identity cert),
+        debounce it through the consecutive-tick patience counters,
+        and act at most once — spawn on a sustained high-watermark
+        breach, retire on a sustained low one. Both streaks reset
+        after any action (a fresh replica deserves a fresh
+        measurement), and the min/max bounds gate the STREAKS, not
+        just the action, so a fleet pinned at a bound does not hold a
+        primed trigger."""
+        hi = self.config.autoscale_high_watermark
+        lo = self.config.autoscale_low_watermark
+        if hi is None and lo is None:
+            return
+        alive = self._alive()
+        if not alive:
+            return
+        try:
+            depth = sum(rep.engine.load()["queue_depth"]
+                        for _, rep in alive) / len(alive)
+        except ReplicaUnavailableError:
+            return      # a child died mid-read; next step() contains it
+        maxr = self.config.autoscale_max_replicas
+        can_grow = maxr is None or len(alive) < maxr
+        can_shrink = len(alive) > self.config.autoscale_min_replicas
+        self._autoscale_hi_streak = (
+            self._autoscale_hi_streak + 1
+            if (hi is not None and depth > hi and can_grow) else 0)
+        self._autoscale_lo_streak = (
+            self._autoscale_lo_streak + 1
+            if (lo is not None and depth < lo and can_shrink) else 0)
+        if self._autoscale_hi_streak >= self.config.autoscale_patience:
+            self._autoscale_hi_streak = 0
+            self._autoscale_lo_streak = 0
+            self._scale_up()
+        elif self._autoscale_lo_streak >= self.config.autoscale_patience:
+            self._autoscale_hi_streak = 0
+            self._autoscale_lo_streak = 0
+            self._scale_down()
+
+    def _scale_up(self) -> None:
+        """Append one fresh replica slot (same spawn path respawn
+        uses) and warm its prefix cache from the survivors — an
+        autoscaled newcomer should serve affinity traffic, not start
+        from a cold index."""
+        idx = len(self.replicas)
+        self._drafters.append(None)
+        self._faults.append(None)
+        self.replicas.append(self._spawn(idx))
+        self._num_spawned += 1
+        if self._obs is not None:
+            self._obs.record("replica_spawn", replica=idx,
+                             reason="autoscale")
+        try:
+            self._warm_replica(idx)
+        except Exception:
+            pass    # warm-up is an optimization, never a dependency
+
+    def _warm_replica(self, idx: int) -> None:
+        """Seed a newcomer's prefix cache with the KV payloads of live
+        prompts (``export_prefix_payloads`` on each owner ->
+        ``import_prefix_payloads`` on the newcomer) — the migration
+        transport, reused as a warm-up. Needs a spill tier on both
+        ends; silently a no-op otherwise."""
+        if not self.config.migrate_spill_payloads:
+            return
+        target = self.replicas[idx].engine
+        for uid, owner in sorted(self._owner.items()):
+            rep = self.replicas[owner]
+            req = self._requests.get(uid)
+            if req is None or not rep.alive or rep.engine is None:
+                continue
+            payloads = rep.engine.export_prefix_payloads(
+                self._seq_hashes(list(req.prompt)))
+            if payloads:
+                target.import_prefix_payloads(payloads)
+
+    def _scale_down(self) -> None:
+        """Retire one replica through the clean drain-and-migrate
+        path. The victim is deterministic: fewest owned live requests
+        (cheapest drain), ties to the HIGHEST index (autoscaled slots
+        retire before the original fleet)."""
+        alive = self._alive()
+        owned: Dict[int, int] = {i: 0 for i, _ in alive}
+        for o in self._owner.values():
+            if o in owned:
+                owned[o] += 1
+        victim = min((i for i, _ in alive),
+                     key=lambda i: (owned[i], -i))
+        try:
+            self.drain_replica(victim, retire=True)
+        except ValueError:
+            return      # last-replica-with-work refusal: not this tick
+        self._num_retired += 1
+        if self._obs is not None:
+            self._obs.record("replica_retire", replica=victim,
+                             reason="autoscale")
+
     # -- health, failover, migration ---------------------------------------
 
     def _fail_replica(self, idx: int, reason: str,
@@ -950,6 +1209,16 @@ class FleetRouter:
                     pass  # keep the periodic checkpoint (or None)
         if not read_host_state:
             rep.engine = None   # the process is gone; so is the object
+        elif rep.mode == "process" and rep.engine is not None:
+            # a process replica's corpse is a real child process:
+            # whatever could be read was read above — now reap it (a
+            # dead handle cannot serve stats either, so the slot
+            # drops the object like the hard-kill path does)
+            try:
+                rep.engine.kill()
+            except Exception:
+                pass
+            rep.engine = None
         # integrity gate (docs/robustness.md): the failover picture is
         # believed only if its content checksum verifies — a corrupt
         # checkpoint is refused and recovery falls back to the fresh
@@ -1123,6 +1392,11 @@ class FleetRouter:
         rep = self.replicas[idx]
         if not rep.alive or rep.engine is None:
             raise ValueError(f"replica {idx} is not alive")
+        if rep.mode == "process":
+            # a REAL SIGKILL, not a simulation: the child OS process
+            # dies mid-whatever-it-was-doing; recovery still runs from
+            # the parent-cached last_checkpoint alone, same contract
+            rep.engine.kill()
         self._fail_replica(idx, "killed", read_host_state=False)
 
     def migrate(self, uids: Optional[Sequence[str]], src: int,
@@ -1264,10 +1538,30 @@ class FleetRouter:
             self._drain_replica_outputs(rep.engine)
             rep.alive = False
             rep.error = "retired"
+            if rep.mode == "process":
+                # clean shutdown of the child; a closed handle cannot
+                # serve stats, so the slot drops the object
+                try:
+                    rep.engine.close()
+                except Exception:
+                    pass
+                rep.engine = None
             if self._obs is not None:
                 self._obs.record("replica_down", replica=src,
                                  reason="retired")
         return moved
+
+    def close(self) -> None:
+        """Dispose every process-replica child (graceful shutdown RPC,
+        then reap). A no-op for in-process replicas and already-dead
+        slots; the router object itself stays usable for ``stats()``
+        reads afterwards but serves nothing."""
+        for rep in self.replicas:
+            if rep.mode == "process" and rep.engine is not None:
+                try:
+                    rep.engine.close()
+                except Exception:
+                    pass
 
     # -- observability -----------------------------------------------------
 
@@ -1285,6 +1579,7 @@ class FleetRouter:
         for i, rep in enumerate(self.replicas):
             row: Dict[str, object] = {
                 "alive": bool(rep.alive and rep.engine is not None),
+                "mode": rep.mode,
                 "routed": rep.routed,
                 "stall_streak": rep.stall_streak,
                 "error": rep.error,
@@ -1323,13 +1618,19 @@ class FleetRouter:
             "num_refused_imports": self._num_refused_imports,
             "num_sdc_checks": self._num_sdc_checks,
             "num_sdc_suspects": self._num_sdc_suspects,
+            # process replicas + autoscaler (docs/fleet.md, "Process
+            # replicas"): autoscaled spawns/retires and the RPC
+            # frame-retry/timeout tally (always 0 in-process)
+            "num_spawned": self._num_spawned,
+            "num_retired": self._num_retired,
+            "num_rpc_retries": self._num_rpc_retries,
+            "num_rpc_timeouts": self._num_rpc_timeouts,
             "num_lost_requests": (self._num_accepted - len(self._owner)
                                   - self._num_terminal),
-            "queue_depth": sum(len(rep.engine.waiting)
+            "queue_depth": sum(rep.engine.queue_depth
                                for _, rep in alive),
-            "active_slots": sum(
-                sum(s is not None for s in rep.engine.slots)
-                for _, rep in alive),
+            "active_slots": sum(rep.engine.active_slot_count
+                                for _, rep in alive),
             "results_pending": len(self._results),
             "stream_backlog": len(self._stream),
             "replicas": reps,

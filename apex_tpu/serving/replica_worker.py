@@ -1,0 +1,239 @@
+"""The child-process replica worker (docs/fleet.md, "Process
+replicas"): ``python -m apex_tpu.serving.replica_worker``.
+
+Spawned by :class:`~apex_tpu.serving.process_replica.ProcessReplica`
+with the frame protocol on stdio. Boot sequence: read ONE ``init``
+frame (engine config record, model spec, expected params checksum,
+optional serialized fault plan and clock spec), rebuild the model from
+the spec, PROVE the weights match the parent's
+(:func:`~apex_tpu.serving.process_replica.params_checksum` — a
+mismatched spec is refused at the handshake, never served), construct
+the :class:`~apex_tpu.serving.engine.InferenceEngine`, and answer a
+``hello``. Then a strictly serial request/response loop: one ``call``
+frame in, one ``resp`` frame out, in order — the parent is the only
+client, so there is no concurrency to manage, and lockstep is what
+makes the retry protocol sound.
+
+Worker-side guarantees:
+
+- **fd hygiene**: stdin/stdout are ``dup``'d for frames and real
+  stdout is re-pointed at stderr FIRST, so a stray ``print`` (jax
+  warnings, user hooks) can never tear a frame;
+- **at-most-once**: the response to the most recent id is cached; a
+  duplicate id (the parent resending after a torn response) is
+  answered from the cache WITHOUT re-executing, so a retried
+  ``add_request``/``import_requests`` never double-applies;
+- **engine errors do not kill the worker**: they serialize into the
+  ``resp`` as typed error records (the parent re-raises the real
+  ``QueueFullError``/``TenantThrottledError``/``ValueError``/
+  ``IntegrityError``) and the loop continues;
+- **torn requests are reported, not fatal**: an ``IntegrityError``
+  reading a frame answers with an id-less error frame — the parent
+  resends under the same id;
+- **checkpoints piggyback**: whenever the engine's periodic
+  ``last_checkpoint`` refreshes, the next ``step`` response carries
+  the sealed snapshot, keeping the parent's failover cache at
+  bounded staleness without extra round trips;
+- **exit**: a clean parent close (``WireClosedError``) or a
+  ``shutdown`` frame ends the process; SIGKILL needs no cooperation,
+  which is the point of the chaos cert.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Tuple
+
+from apex_tpu.serving import wire
+from apex_tpu.utils.integrity import IntegrityError
+
+
+def _error_record(e: BaseException) -> Dict:
+    rec = {"type": type(e).__name__, "message": str(e)}
+    if isinstance(e, IntegrityError):
+        rec["site"] = e.site
+        rec["detail"] = e.detail
+    return rec
+
+
+class _Servicer:
+    """Method dispatch + argument/result codecs around one live
+    engine (the worker-side mirror of ``ProcessReplica._call``)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        # identity of the last checkpoint already shipped to the
+        # parent — piggybacking keys on it, not on tick counts
+        self._ckpt_sent = None
+
+    def dispatch(self, method: str, args: List) -> Tuple[object, Dict]:
+        """``(result, extra_response_fields)`` for one RPC."""
+        from apex_tpu.serving.process_replica import request_from_record
+
+        eng = self.engine
+        if method == "add_request":
+            return int(eng.add_request(request_from_record(args[0]))), {}
+        if method == "step":
+            busy = bool(eng.step())
+            extra: Dict = {}
+            snap = eng.last_checkpoint
+            if snap is not None and id(snap) != self._ckpt_sent:
+                extra["checkpoint"] = snap
+                self._ckpt_sent = id(snap)
+            return busy, extra
+        if method == "has_work":
+            return bool(eng.has_work), {}
+        if method == "load":
+            return eng.load(), {}
+        if method == "probe_prefix":
+            return int(eng.probe_prefix(list(args[0]))), {}
+        if method == "export_requests":
+            uids = args[0] if args else None
+            return eng.export_requests(uids), {}
+        if method == "import_requests":
+            return int(eng.import_requests(args[0])), {}
+        if method == "pop_results":
+            return {uid: {"tokens": [int(t) for t in res.tokens],
+                          "status": res.status}
+                    for uid, res in eng.pop_results().items()}, {}
+        if method == "pop_stream_events":
+            return [[uid, int(tok), bool(last)]
+                    for uid, tok, last in eng.pop_stream_events()], {}
+        if method == "abort":
+            return bool(eng.abort(args[0])), {}
+        if method == "checkpoint":
+            snap = eng.checkpoint()
+            self._ckpt_sent = id(snap)
+            return snap, {}
+        if method == "export_prefix_payloads":
+            return wire.encode_arrays(
+                eng.export_prefix_payloads(list(args[0]))), {}
+        if method == "import_prefix_payloads":
+            return int(eng.import_prefix_payloads(
+                wire.decode_arrays(args[0]))), {}
+        if method == "stats":
+            import json
+
+            # one normalization pass (tuples -> lists, the odd
+            # non-JSON scalar -> str) so the frame encoder never
+            # chokes on a stats leaf
+            return json.loads(json.dumps(eng.stats(), default=str)), {}
+        if method == "block_weight":
+            return float(eng.block_weight), {}
+        if method == "queue_depth":
+            return int(eng.queue_depth), {}
+        if method == "active_slot_count":
+            return int(eng.active_slot_count), {}
+        if method == "tenant_charge":
+            return eng.tenant_charge(args[0]), {}
+        if method == "tenant_depth":
+            return int(eng.tenant_depth(args[0])), {}
+        raise ValueError(f"unknown RPC method {method!r}")
+
+
+def _boot(init: Dict):
+    """Model + engine from the init frame; raises on any mismatch
+    (the caller turns it into a refused hello)."""
+    from apex_tpu.serving.engine import InferenceEngine
+    from apex_tpu.serving.process_replica import (
+        build_model_from_spec,
+        clock_from_spec,
+        engine_config_from_record,
+        params_checksum,
+    )
+    from apex_tpu.utils.faults import plan_from_record
+
+    config = engine_config_from_record(init["config"])
+    model, params = build_model_from_spec(init["model_spec"])
+    expect = init.get("params_checksum")
+    if expect is not None:
+        got = params_checksum(params)
+        if got != expect:
+            raise IntegrityError(
+                "wire", f"child-rebuilt params checksum {got} != "
+                        f"parent's {expect}: the model spec does not "
+                        "reproduce the parent's weights")
+    plan_rec = init.get("faults")
+    faults = None if plan_rec is None else plan_from_record(plan_rec)
+    clock = clock_from_spec(init.get("clock"))
+    return InferenceEngine(model, params, config, faults=faults,
+                           clock=clock)
+
+
+def main() -> int:
+    # fd hygiene FIRST: frames own private dups of stdin/stdout, and
+    # fd 1 is re-pointed at stderr so any stray print lands in the
+    # parent's stderr stream instead of inside a frame
+    in_fd = os.dup(0)
+    out_fd = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    try:
+        init = wire.read_frame(in_fd)
+        if init.get("type") != "init":
+            raise ValueError(
+                f"expected an init frame, got {init.get('type')!r}")
+        servicer = _Servicer(_boot(init))
+    except wire.WireClosedError:
+        return 0
+    except BaseException as e:  # noqa: BLE001 - refused hello carries it
+        try:
+            wire.write_frame(out_fd, {"type": "hello", "ok": False,
+                                      "error": _error_record(e)})
+        except Exception:
+            pass
+        return 1
+    wire.write_frame(out_fd, {"type": "hello", "ok": True,
+                              "pid": os.getpid()})
+
+    last_id = None
+    last_resp = None
+    while True:
+        try:
+            msg = wire.read_frame(in_fd)
+        except wire.WireClosedError:
+            return 0
+        except IntegrityError as e:
+            # a torn REQUEST: report without an id; the parent resends
+            wire.write_frame(out_fd, {"type": "resp", "id": None,
+                                      "ok": False,
+                                      "error": _error_record(e)})
+            continue
+        mtype = msg.get("type")
+        if mtype == "shutdown":
+            wire.write_frame(out_fd, {"type": "resp",
+                                      "id": msg.get("id"),
+                                      "ok": True, "result": None})
+            return 0
+        if mtype != "call":
+            wire.write_frame(out_fd, {"type": "resp", "id": None,
+                                      "ok": False,
+                                      "error": {"type": "ValueError",
+                                                "message": f"unexpected "
+                                                f"frame type {mtype!r}"}})
+            continue
+        mid = msg.get("id")
+        if mid is not None and mid == last_id:
+            # at-most-once: the parent resent after a torn response —
+            # answer from the cache, never re-execute
+            wire.write_frame(out_fd, last_resp)
+            continue
+        try:
+            result, extra = servicer.dispatch(msg.get("method"),
+                                              msg.get("args") or [])
+            resp = {"type": "resp", "id": mid, "ok": True,
+                    "result": result}
+            resp.update(extra)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 - typed error resp
+            resp = {"type": "resp", "id": mid, "ok": False,
+                    "error": _error_record(e)}
+        last_id, last_resp = mid, resp
+        wire.write_frame(out_fd, resp)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

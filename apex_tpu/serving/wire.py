@@ -1,0 +1,205 @@
+"""The framed RPC wire protocol for out-of-process replicas
+(docs/fleet.md, "Process replicas").
+
+One frame = an 8-byte big-endian length prefix + the UTF-8 bytes of
+one JSON record, SEALED with :func:`~apex_tpu.utils.integrity.
+seal_record` before encoding and verified with :func:`verify_record`
+after parsing — so a torn, truncated, or rotted frame is an
+:class:`~apex_tpu.utils.integrity.IntegrityError` at the reader,
+never a silent mis-parse. The module is deliberately minimal and
+stdlib-only (``struct``/``select``/``os``/``json`` — no sockets, no
+serialization framework): frames ride ordinary pipe file descriptors
+(the child's stdin/stdout), and everything protocol-level above a
+frame — request ids, method dispatch, retries, at-most-once dedupe —
+belongs to :mod:`~apex_tpu.serving.process_replica` and
+:mod:`~apex_tpu.serving.replica_worker`.
+
+Failure taxonomy (the reader's contract):
+
+- clean EOF at a frame boundary → :class:`WireClosedError` (the peer
+  exited; for a parent this is replica death, for a child it is
+  shutdown);
+- EOF mid-header or mid-body → ``IntegrityError("wire", "truncated
+  ...")`` (a torn frame: the peer died mid-write, or a chaos plan
+  truncated it);
+- a body that is not valid JSON → ``IntegrityError("wire", "torn
+  frame ...")``;
+- a parsed record whose embedded checksum mismatches →
+  ``IntegrityError`` from :func:`verify_record` (frame rot);
+- a length prefix beyond ``max_bytes`` → ``IntegrityError("wire",
+  "oversize frame ...")``, REFUSED before a single body byte is read
+  (a corrupt length must not make the reader allocate gigabytes);
+- no bytes within ``timeout_s`` → :class:`WireTimeoutError` (an
+  unresponsive peer — the parent's per-call timeout).
+
+Numpy arrays (KV payloads riding ``export_prefix_payloads`` /
+``import_prefix_payloads``) do not fit JSON: callers encode them with
+:func:`encode_arrays` (base64 + dtype + shape markers) BEFORE the
+frame is sealed and decode with :func:`decode_arrays` after it
+verifies, so the checksum covers exactly the bytes on the wire.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import select
+import struct
+from typing import Dict, Optional
+
+from apex_tpu.utils.integrity import (
+    IntegrityError,
+    seal_record,
+    verify_record,
+)
+
+# the one sealed-record site name every frame verifies under
+WIRE_SITE = "wire"
+# 8-byte big-endian unsigned length prefix
+_HEADER = struct.Struct(">Q")
+HEADER_BYTES = _HEADER.size
+# the oversize-refusal bound: far above any real frame (a tiny-model
+# KV payload is kilobytes; a checkpoint is bounded by the queue), far
+# below anything a corrupt length prefix could use to OOM the reader
+MAX_FRAME_BYTES = 64 << 20
+
+_ARRAY_KEY = "__ndarray__"
+
+
+class WireClosedError(RuntimeError):
+    """The peer closed the pipe at a clean frame boundary — process
+    exit, not corruption. A parent treats this as replica death; a
+    child treats it as shutdown."""
+
+
+class WireTimeoutError(RuntimeError):
+    """No (complete) frame arrived within the reader's timeout — the
+    peer is alive-but-unresponsive, the failure mode a parent must
+    bound (docs/fleet.md, RPC timeout/retry policy)."""
+
+
+def encode_frame(record: Dict, max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """Seal ``record`` (in place, like every sealed artifact) and
+    encode it as one length-prefixed frame. Refuses — with
+    ``IntegrityError`` — to build a frame past ``max_bytes``: the
+    writer's half of the oversize contract, so a runaway payload fails
+    loudly at the sender instead of being refused at the reader."""
+    body = json.dumps(seal_record(record),
+                      separators=(",", ":")).encode("utf-8")
+    if len(body) > max_bytes:
+        raise IntegrityError(
+            WIRE_SITE, f"refusing to encode oversize frame: "
+                       f"{len(body)} bytes > max {max_bytes}")
+    return _HEADER.pack(len(body)) + body
+
+
+def write_frame(fd: int, record: Dict,
+                max_bytes: int = MAX_FRAME_BYTES) -> None:
+    """Write one sealed frame to a raw file descriptor. A
+    ``BrokenPipeError``/``OSError`` propagates — the peer is gone and
+    the caller owns that verdict (``ReplicaUnavailableError`` for a
+    parent, exit for a child)."""
+    data = encode_frame(record, max_bytes)
+    view = memoryview(data)
+    while view:
+        n = os.write(fd, view)
+        view = view[n:]
+
+
+def _read_exact(fd: int, n: int, timeout_s: Optional[float],
+                what: str) -> bytes:
+    """Read exactly ``n`` bytes. EOF with ZERO bytes read is the
+    caller's to interpret (returned as ``b""`` only when ``what`` is
+    the header — a clean close); EOF mid-read is a torn frame."""
+    chunks = []
+    got = 0
+    while got < n:
+        if timeout_s is not None:
+            ready, _, _ = select.select([fd], [], [], timeout_s)
+            if not ready:
+                raise WireTimeoutError(
+                    f"no {what} bytes within {timeout_s}s "
+                    f"({got}/{n} read)")
+        chunk = os.read(fd, n - got)
+        if not chunk:
+            if got == 0 and what == "header":
+                raise WireClosedError("peer closed at a frame boundary")
+            raise IntegrityError(
+                WIRE_SITE, f"truncated {what}: peer closed after "
+                           f"{got}/{n} bytes")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(fd: int, timeout_s: Optional[float] = None,
+               max_bytes: int = MAX_FRAME_BYTES,
+               chaos=None) -> Dict:
+    """Read and verify one frame from a raw file descriptor.
+
+    ``chaos`` is the parent-side fault seam (docs/robustness.md): a
+    ``bytes -> bytes`` hook applied to the received body BEFORE
+    parsing, so a seeded plan can truncate or rot exactly the frame it
+    means to — the resulting parse/checksum failure then exercises the
+    real retry path. The hook runs after the full frame left the pipe,
+    so a simulated truncation never desyncs the stream."""
+    header = _read_exact(fd, HEADER_BYTES, timeout_s, "header")
+    (length,) = _HEADER.unpack(header)
+    if length > max_bytes:
+        raise IntegrityError(
+            WIRE_SITE, f"oversize frame refused: length prefix "
+                       f"{length} bytes > max {max_bytes}")
+    body = _read_exact(fd, length, timeout_s, "body")
+    if chaos is not None:
+        body = chaos(body)
+    try:
+        record = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IntegrityError(
+            WIRE_SITE, f"torn frame: body is not valid JSON ({e})")
+    if not isinstance(record, dict):
+        raise IntegrityError(
+            WIRE_SITE, f"torn frame: expected a record object, got "
+                       f"{type(record).__name__}")
+    verify_record(record, WIRE_SITE)
+    return record
+
+
+def encode_arrays(obj):
+    """Recursively replace numpy arrays with JSON-able
+    ``{"__ndarray__": {dtype, shape, b64}}`` markers (a NEW tree; the
+    input is never mutated). Applied BEFORE sealing, so the frame
+    checksum covers the encoded bytes end to end."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        return {_ARRAY_KEY: {
+            "dtype": str(a.dtype),
+            "shape": [int(s) for s in a.shape],
+            "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+        }}
+    if isinstance(obj, dict):
+        return {k: encode_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_arrays(v) for v in obj]
+    return obj
+
+
+def decode_arrays(obj):
+    """Invert :func:`encode_arrays` after the frame verified: markers
+    become numpy arrays (bit-identical to the sender's — base64 is
+    lossless and dtype/shape ride along)."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        if set(obj) == {_ARRAY_KEY}:
+            m = obj[_ARRAY_KEY]
+            return np.frombuffer(
+                base64.b64decode(m["b64"]),
+                dtype=np.dtype(m["dtype"])).reshape(m["shape"]).copy()
+        return {k: decode_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_arrays(v) for v in obj]
+    return obj

@@ -36,7 +36,14 @@ Four fault kinds, mirroring the ways a dispatch (or its data) dies:
   records, one fire per record) — where checksum verification must
   catch it, and at ``"decode"``, where it models a flaky chip emitting
   a wrong token (no checksum can catch compute corruption; the fleet's
-  determinism cross-check does).
+  determinism cross-check does). The ``"wire"`` site (docs/fleet.md,
+  "Process replicas") is the cross-process frame path: ``corrupt``
+  there rots one numeric leaf of a received frame and ``transient``
+  truncates it (:func:`wire_chaos`), so the parent's
+  verify-and-resend loop is exercised without a real flaky pipe —
+  only those two kinds are legal at the site
+  (:func:`validate_wire_specs`, checked at replica construction the
+  way the engine checks its integrity sites).
 
 The plan fires BEFORE the wrapped call for ``transient``/``crash``
 (the dispatch never launches, so no donated buffer is consumed and the
@@ -56,6 +63,12 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _FAULT_KINDS = ("transient", "nan", "crash", "corrupt")
+# the cross-process frame path only has two failure modes worth
+# modeling — a rotted frame (corrupt) and a torn one (transient, which
+# the hook realizes as truncation); "crash" there is just child death
+# (SIGKILL the child instead) and "nan" has no float artifact to hit
+WIRE_SITE = "wire"
+WIRE_FAULT_KINDS = ("transient", "corrupt")
 
 
 class TransientDispatchError(RuntimeError):
@@ -367,6 +380,98 @@ def perturb_tokens(tokens, counts, vocab_size: int, seed: int):
     tokens[lane, pos] = (old + 1 + rng.randint(vocab_size - 1)) \
         % vocab_size
     return tokens
+
+
+def validate_wire_specs(specs: Sequence[FaultSpec]) -> None:
+    """Construction-time validation of ``"wire"``-site rules: only
+    :data:`WIRE_FAULT_KINDS` are legal there (the same discipline the
+    engine applies to its integrity sites) — a plan wiring ``crash``
+    or ``nan`` at the frame path is a test bug, surfaced at replica
+    construction instead of silently never firing."""
+    for spec in specs:
+        if spec.site == WIRE_SITE and spec.kind not in WIRE_FAULT_KINDS:
+            raise ValueError(
+                f"fault kind {spec.kind!r} is not valid at site "
+                f"{WIRE_SITE!r}; legal kinds: {WIRE_FAULT_KINDS} "
+                "(SIGKILL the child to model a crash)")
+
+
+def wire_chaos(plan: FaultPlan):
+    """The parent-side frame chaos hook: a ``bytes -> bytes`` callable
+    for ``wire.read_frame(chaos=...)``, firing ``plan`` at the
+    ``"wire"`` site once per received frame. A ``transient`` hit
+    truncates the body to half (a torn frame — the reader's JSON parse
+    fails with an ``IntegrityError``); a ``corrupt`` hit perturbs one
+    numeric leaf via :func:`perturb_json` and re-encodes (the embedded
+    checksum goes stale — ``verify_record`` refuses). Either way the
+    full frame already left the pipe, so the simulated damage never
+    desyncs the stream — the parent's resend of the SAME request id
+    exercises the real retry/dedupe path."""
+    validate_wire_specs(plan.specs)
+
+    def hook(body: bytes) -> bytes:
+        import json
+
+        try:
+            plan.fire(WIRE_SITE)
+        except TransientDispatchError:
+            return body[: len(body) // 2]
+        seed = plan.corrupt_seed(WIRE_SITE)
+        if seed is not None:
+            rec = perturb_json(json.loads(body.decode("utf-8")), seed)
+            return json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        return body
+
+    return hook
+
+
+def spec_record(spec: FaultSpec) -> Dict:
+    """One :class:`FaultSpec` as a JSON-able record — the shape a
+    fault plan rides to a child replica process in (docs/fleet.md,
+    "Process replicas")."""
+    return {
+        "site": spec.site,
+        "kind": spec.kind,
+        "at": list(spec.at),
+        "every": spec.every,
+        "prob": spec.prob,
+        "max_fires": spec.max_fires,
+    }
+
+
+def plan_record(plan: FaultPlan) -> Dict:
+    """A FRESH plan's declarative content (seed + specs) as a
+    JSON-able record. Runtime state (call counters, the audit log) is
+    deliberately not carried: the receiver reconstructs an unfired
+    plan, which is the only thing it makes sense to ship."""
+    return {"seed": plan.seed,
+            "specs": [spec_record(s) for s in plan.specs]}
+
+
+def plan_from_record(rec: Dict) -> FaultPlan:
+    """Invert :func:`plan_record` — ``FaultSpec.__post_init__``
+    re-validates every rule, so a rotted record fails loudly here."""
+    specs = [FaultSpec(site=s["site"], kind=s["kind"],
+                       at=tuple(s.get("at") or ()),
+                       every=s.get("every"),
+                       prob=float(s.get("prob") or 0.0),
+                       max_fires=s.get("max_fires"))
+             for s in rec.get("specs", ())]
+    return FaultPlan(specs, seed=int(rec.get("seed", 0)))
+
+
+def split_plan(plan: Optional[FaultPlan], site: str
+               ) -> Tuple[Optional[FaultPlan], Optional[FaultPlan]]:
+    """Partition a plan into ``(at_site, elsewhere)`` sub-plans (same
+    seed, None where empty): the router keeps the ``"wire"`` rules on
+    its side of the pipe and ships the rest to the child, so one chaos
+    plan still describes the whole replica."""
+    if plan is None:
+        return None, None
+    here = [s for s in plan.specs if s.site == site]
+    there = [s for s in plan.specs if s.site != site]
+    return (FaultPlan(here, seed=plan.seed) if here else None,
+            FaultPlan(there, seed=plan.seed) if there else None)
 
 
 def nan_corrupt(tree):

@@ -3,7 +3,8 @@
 
 Every host artifact the serving stack moves between processes,
 replicas, or memory tiers — snapshot/checkpoint records, spilled KV
-blocks, migration records, cross-replica KV payloads — is consumed by
+blocks, migration records, cross-replica KV payloads, every RPC frame
+on the process-replica wire (``serving/wire.py``) — is consumed by
 machinery that TRUSTS its bytes. A bit flip in host RAM, a truncated
 copy, or a buggy transport therefore does not crash: it silently
 serves wrong tokens, re-prefills a corrupted history, or attends
@@ -54,10 +55,12 @@ class IntegrityError(RuntimeError):
     """A checksummed artifact failed verification at consumption.
 
     Carries the consumption ``site`` (``"spill_get"``, ``"restore"``,
-    ``"import"``, ``"checkpoint"``, ...) so counters and the flight
-    recorder can attribute the detection. Raised only where refusal is
-    the correct degradation (migration imports, operator restores);
-    cache-tier consumers detect-and-discard instead of raising."""
+    ``"import"``, ``"checkpoint"``, ``"wire"``, ...) so counters and
+    the flight recorder can attribute the detection. Raised only where
+    refusal is the correct degradation (migration imports, operator
+    restores, torn RPC frames — the parent resends, the worker asks
+    for a resend); cache-tier consumers detect-and-discard instead of
+    raising."""
 
     def __init__(self, site: str, detail: str):
         super().__init__(f"integrity check failed at {site!r}: {detail}")

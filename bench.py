@@ -2785,6 +2785,283 @@ def bench_train_step(fast=False):
     }
 
 
+def bench_serving_process(fast=False):
+    """Out-of-process replica arm (round 16, docs/fleet.md "Process
+    replicas" + "Autoscaler"): the child-process serving runtime and
+    the elastic autoscaler, certified where they matter — a child
+    SIGKILLED for real mid-burst, and a fleet that grows and shrinks
+    without flapping.
+
+    Three phases: (0) identity — a 1-process-replica fleet (the engine
+    in a CHILD OS process behind the framed stdio RPC) must be
+    BIT-IDENTICAL to the in-process 1-replica fleet: outputs, terminal
+    statuses, and the full constant-clock fleet ``stats()`` (only the
+    per-replica ``mode`` tag differs, popped before compare); (1) a
+    2-process-replica fleet serves a seeded Poisson burst while one
+    child is ``os.kill``-SIGKILLED mid-burst with respawn on — ZERO
+    lost accepted requests, every accepted uid terminal exactly once,
+    at least one failover, a FRESH child pid in the victim slot, and
+    the victims' p99 TTFT (scheduler ticks) bounded vs the kill-free
+    in-process baseline on the same trace; (2) the autoscaler rides a
+    burst-then-drain ramp in-process (the control loop is
+    mode-agnostic; in-process keeps the phase child-free): the fleet
+    grows under load, shrinks back to min when drained, spawn/retire
+    counts balance, and an idle tail of ticks shows zero flapping.
+
+    Always the tiny host shape: process replicas are a HOST runtime
+    mechanism (device kernels untouched), and two processes cannot
+    share one TPU — on a TPU parent the children are forced to
+    ``JAX_PLATFORMS=cpu`` and the parent arms pin to the CPU backend
+    so phase 0 compares like with like. ``fast=True`` is the tier-1
+    smoke shape."""
+    import contextlib
+    import signal as _signal
+
+    from apex_tpu.models import GPTConfig
+    from apex_tpu.observability import percentile
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  Request, SamplingParams)
+    from apex_tpu.serving.process_replica import (build_model_from_spec,
+                                                  gpt_model_spec)
+
+    backend = _backend_with_cpu_fallback()
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    spec = gpt_model_spec(cfg)
+    ekw = dict(max_batch=4, block_size=8, num_blocks=64,
+               max_prefill_len=16, max_seq_len=48,
+               enable_prefix_caching=True,
+               snapshot_interval_ticks=2, max_waiting=32, seed=11)
+    ticks = 10 if fast else 16
+    rate = 0.5 if fast else 0.7
+    prompt_lens, max_news = (8, 14), (4, 6)
+    kill_tick = 4 if fast else 6
+
+    stack = contextlib.ExitStack()
+    prev_platforms = os.environ.get("JAX_PLATFORMS")
+    if backend != "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        stack.enter_context(jax.default_device(jax.devices("cpu")[0]))
+    try:
+        # the parent builds (model, params) FROM the spec — the same
+        # deterministic init the children replay, so the boot
+        # checksum handshake passes by construction
+        model, params = build_model_from_spec(spec)
+
+        def make_trace():
+            rng = np.random.RandomState(1914)
+
+            def make(tick, k):
+                prompt = list(rng.randint(1, cfg.vocab_size,
+                                          int(rng.choice(prompt_lens))))
+                samp = (SamplingParams() if k % 2 else
+                        SamplingParams(temperature=1.0, top_k=40))
+                new = int(rng.choice(max_news))
+                return lambda: Request(uid=f"q{k}", prompt=list(prompt),
+                                       max_new_tokens=new, sampling=samp)
+
+            return _poisson_burst_trace(
+                rng, ticks=ticks, base_rate=rate, make_request=make,
+                burst_start=ticks // 3, burst_end=2 * ticks // 3,
+                burst_factor=3)
+
+        def drive(router, trace, kill_at=None, kill_idx=None):
+            """Tick through the trace; the kill is a REAL ``os.kill``
+            SIGKILL on the child pid (no cooperation — the parent
+            discovers the corpse through the RPC layer). Returns
+            (ttft_ticks, accepted, victims, wall_s)."""
+            submit, first = {}, {}
+            accepted, victims = [], None
+            t0 = time.perf_counter()
+            i = tick = 0
+            while i < len(trace) or router.has_work:
+                while i < len(trace) and trace[i][0] <= tick:
+                    req = trace[i][1]()
+                    if router.try_add(req):
+                        submit[req.uid] = tick
+                        accepted.append(req.uid)
+                    i += 1
+                if (kill_at is not None and tick == kill_at
+                        and router.replicas[kill_idx].alive):
+                    victims = [u for u, o in router.owners().items()
+                               if o == kill_idx]
+                    os.kill(router.replicas[kill_idx].engine.child_pid,
+                            _signal.SIGKILL)
+                router.step()
+                for uid, tok, last in router.pop_stream_events():
+                    if tok >= 0 and uid not in first and uid in submit:
+                        first[uid] = tick
+                tick += 1
+            wall = time.perf_counter() - t0
+            ttft = {u: first[u] - submit[u] for u in first}
+            return ttft, accepted, victims, wall
+
+        def pct(xs, q):
+            return percentile(xs, q) if xs else 0.0
+
+        proc_kw = dict(model_spec=spec,
+                       child_clock={"kind": "constant", "t": 0.0})
+
+        # -- phase 0: the 1-process-replica identity cert (constant
+        # clocks both sides: every time-derived stat equal by
+        # construction, so the FULL fleet stats dict compares) --
+        ident = make_trace()[:6]
+
+        def run_one(mode):
+            kw = proc_kw if mode == "process" else {}
+            fleet = FleetRouter(model, params, EngineConfig(**ekw),
+                                FleetConfig(num_replicas=1,
+                                            replica_mode=mode),
+                                clock=lambda: 0.0, **kw)
+            try:
+                for _, mk in ident:
+                    fleet.add_request(mk())
+                res = fleet.run(return_status=True)
+                stats = json.loads(json.dumps(fleet.stats(),
+                                              sort_keys=True,
+                                              default=str))
+                for row in stats["replicas"].values():
+                    row.pop("mode")
+                return ({u: (tuple(r.tokens), r.status)
+                         for u, r in res.items()}, stats)
+            finally:
+                fleet.close()
+
+        in_res, in_stats = run_one("in_process")
+        pr_res, pr_stats = run_one("process")
+        assert pr_res == in_res, \
+            "process fleet outputs diverged from in-process"
+        assert pr_stats == in_stats, \
+            "process fleet stats diverged from in-process"
+
+        # -- phase 1: kill-free in-process baseline, then the same
+        # trace on a 2-process-replica fleet with a mid-burst SIGKILL
+        # on one child --
+        trace = make_trace()
+        base = FleetRouter(model, params, EngineConfig(**ekw),
+                           FleetConfig(num_replicas=2))
+        ttft_base, accepted_base, _, wall_base = drive(base, trace)
+        base_res = base.run(return_status=True)
+        assert base.stats()["num_lost_requests"] == 0
+        base_good = sum(len(r.tokens) for r in base_res.values()
+                        if r.status == "finished") / max(wall_base, 1e-9)
+        p99_base = pct(list(ttft_base.values()), 99)
+
+        router = FleetRouter(model, params, EngineConfig(**ekw),
+                             FleetConfig(num_replicas=2,
+                                         replica_mode="process",
+                                         respawn=True),
+                             **proc_kw)
+        try:
+            pid0 = router.replicas[0].engine.child_pid
+            ttft_kill, accepted, victims, wall_kill = drive(
+                router, trace, kill_at=kill_tick, kill_idx=0)
+            kill_res = router.run(return_status=True)
+            stats = router.stats()
+            missing = set(accepted) - set(kill_res)
+            assert not missing, \
+                f"lost accepted requests: {sorted(missing)}"
+            assert stats["num_lost_requests"] == 0
+            assert len(set(accepted)) == len(accepted)
+            assert stats["num_failovers"] >= 1, "the kill never fired"
+            assert stats["num_respawns"] >= 1, "no respawn after kill"
+            fresh = router.replicas[0].engine
+            pids_fresh = fresh is not None and fresh.child_pid != pid0
+            assert pids_fresh, "victim slot did not get a fresh child"
+        finally:
+            router.close()
+        victims = victims or []
+        victim_ttft = [ttft_kill[u] for u in victims if u in ttft_kill]
+        p99_victim = pct(victim_ttft, 99)
+        victim_bound = 4.0 * p99_base + 16.0
+        assert p99_victim <= victim_bound, (
+            f"victim p99 TTFT {p99_victim} ticks vs baseline "
+            f"{p99_base} (bound {victim_bound})")
+        kill_good = sum(len(r.tokens) for r in kill_res.values()
+                        if r.status == "finished") / max(wall_kill, 1e-9)
+
+        # -- phase 2: the autoscale ramp, in-process (child-free) --
+        ramp = FleetRouter(
+            model, params,
+            EngineConfig(**{**ekw, "max_batch": 1}),
+            FleetConfig(num_replicas=1,
+                        autoscale_high_watermark=1.0,
+                        autoscale_low_watermark=0.5,
+                        autoscale_patience=2,
+                        autoscale_max_replicas=3))
+        n_ramp = 8 if fast else 12
+        rng = np.random.RandomState(1915)
+        for k in range(n_ramp):
+            ramp.add_request(Request(
+                uid=f"r{k}", prompt=list(rng.randint(1, cfg.vocab_size,
+                                                     6)),
+                max_new_tokens=12, sampling=SamplingParams()))
+        sizes = []
+        while ramp.has_work:
+            ramp.step()
+            sizes.append(len(ramp._alive()))
+        rs = ramp.stats()
+        assert max(sizes) > 1, "the ramp never triggered a spawn"
+        assert sizes[-1] == 1, "the drained fleet did not shrink to min"
+        assert max(sizes) <= 3 and min(sizes) >= 1
+        assert rs["num_spawned"] == rs["num_retired"] >= 1
+        assert rs["num_lost_requests"] == 0
+        assert len(ramp.run()) == n_ramp
+        before = (rs["num_spawned"], rs["num_retired"])
+        for _ in range(8):                      # idle tail: no flapping
+            ramp.step()
+        after = ramp.stats()
+        flap_free = (after["num_spawned"], after["num_retired"]) == before
+        assert flap_free, "the idle fleet flapped"
+    finally:
+        stack.close()
+        if backend != "cpu":
+            if prev_platforms is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev_platforms
+
+    print(f"# serving process: identity OK | baseline p99 TTFT "
+          f"{p99_base:.0f} ticks, goodput {base_good:.1f} tok/s | "
+          f"SIGKILL@{kill_tick} (victims {len(victims)}) p99 "
+          f"{p99_victim:.0f} ticks (bound {victim_bound:.0f}), "
+          f"goodput {kill_good:.1f} tok/s | failovers "
+          f"{stats['num_failovers']}, respawns {stats['num_respawns']}, "
+          f"rpc retries {stats['num_rpc_retries']}, rpc timeouts "
+          f"{stats['num_rpc_timeouts']} | ramp peak {max(sizes)} "
+          f"replicas, spawned {after['num_spawned']}, retired "
+          f"{after['num_retired']}", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_process_kill_goodput_tok_per_sec",
+        "value": round(kill_good, 3),
+        "unit": "tokens/sec",
+        # SIGKILL-tolerance quality: goodput with a child killed
+        # mid-burst vs the kill-free in-process fleet (wall-clock, so
+        # the respawn boot cost shows here, not in ticks)
+        "vs_baseline": round(kill_good / max(base_good, 1e-9), 4),
+        "identity_ok": True,
+        "zero_lost": True,
+        "child_pid_fresh": True,
+        "num_offered": len(trace),
+        "num_accepted": len(accepted),
+        "num_victims": len(victims),
+        "victim_p99_ttft_ticks": round(float(p99_victim), 2),
+        "victim_p99_bound_ticks": round(float(victim_bound), 2),
+        "baseline_p99_ttft_ticks": round(float(p99_base), 2),
+        "num_failovers": int(stats["num_failovers"]),
+        "num_respawns": int(stats["num_respawns"]),
+        "num_rpc_retries": int(stats["num_rpc_retries"]),
+        "num_rpc_timeouts": int(stats["num_rpc_timeouts"]),
+        "num_lost_requests": int(stats["num_lost_requests"]),
+        "autoscale_peak_replicas": int(max(sizes)),
+        "autoscale_num_spawned": int(after["num_spawned"]),
+        "autoscale_num_retired": int(after["num_retired"]),
+        "autoscale_flap_free": True,
+        "status_counts": {
+            s: sum(r.status == s for r in kill_res.values())
+            for s in {r.status for r in kill_res.values()}},
+    }
+
+
 def bench_obs_pipeline(fast=False):
     """Observability pipeline certification (docs/observability.md):
     drive a small engine with the full observer attached (tracer +
@@ -2905,6 +3182,8 @@ def main():
              lambda: bench_serving_integrity(fast=True)),
             ("bench_serving_mesh",
              lambda: bench_serving_mesh(fast=True)),
+            ("bench_serving_process",
+             lambda: bench_serving_process(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -2971,8 +3250,8 @@ def main():
                  bench_serving_speculative, bench_serving_overload,
                  bench_serving_multitenant, bench_serving_kv_memory,
                  bench_serving_fleet, bench_serving_integrity,
-                 bench_serving_mesh, bench_train_step,
-                 bench_obs_pipeline]
+                 bench_serving_mesh, bench_serving_process,
+                 bench_train_step, bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)
         secondary.append(bench_long_context)

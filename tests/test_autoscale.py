@@ -1,0 +1,232 @@
+"""Elastic autoscaler certification (tier-1, CPU): the ISSUE 16
+control loop in :class:`~apex_tpu.serving.fleet.FleetRouter`
+(docs/fleet.md, "Autoscaler").
+
+The contract under test: spawn only after a SUSTAINED high-watermark
+breach (the consecutive-tick patience debounce — a one-tick spike
+never scales), retire through the clean drain-and-migrate path on a
+sustained low-watermark, never cross ``autoscale_min_replicas`` /
+``autoscale_max_replicas`` (the bounds gate the STREAKS, so a fleet
+pinned at a bound holds no primed trigger), no flapping at steady
+state, spawn/retire surfaced in ``stats()``
+(``num_spawned``/``num_retired``) and the flight recorder
+(``replica_spawn``/``replica_retire`` + the trace_summary autoscaler
+line) — and the never-firing identity cert: a fleet with ±inf
+watermarks runs BIT-IDENTICAL to a static fleet (outputs, statuses,
+full stats), because the armed-but-idle control loop is pure
+``load()`` reads."""
+
+import importlib.util
+import json
+import math
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import GPTConfig, GPTLMHeadModel
+from apex_tpu.observability import Observability
+from apex_tpu.serving import (
+    EngineConfig,
+    FleetConfig,
+    FleetRouter,
+    Request,
+    SamplingParams,
+)
+
+ENGINE_KW = dict(max_batch=1, block_size=4, num_blocks=64,
+                 max_prefill_len=8, max_seq_len=48, seed=7,
+                 enable_prefix_caching=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _fleet(tiny_gpt, n=1, fleet_kw=None, obs=None, clock=None,
+           **overrides):
+    model, params = tiny_gpt
+    kw = dict(ENGINE_KW)
+    kw.update(overrides)
+    return FleetRouter(model, params, EngineConfig(**kw),
+                       FleetConfig(num_replicas=n, **(fleet_kw or {})),
+                       obs=obs, clock=clock)
+
+
+def _reqs(n, new=8, seed=3, uid="a"):
+    rng = np.random.RandomState(seed)
+    return [Request(f"{uid}{k}", list(rng.randint(1, 50, 6)),
+                    max_new_tokens=new, sampling=SamplingParams())
+            for k in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_config_validation():
+    FleetConfig(autoscale_high_watermark=4.0,
+                autoscale_low_watermark=1.0)    # legal
+    with pytest.raises(ValueError, match="autoscale_high_watermark"):
+        FleetConfig(autoscale_high_watermark=1.0,
+                    autoscale_low_watermark=2.0)
+    with pytest.raises(ValueError, match="autoscale_patience"):
+        FleetConfig(autoscale_patience=0)
+    with pytest.raises(ValueError, match="autoscale_min_replicas"):
+        FleetConfig(autoscale_min_replicas=0)
+    with pytest.raises(ValueError, match="autoscale_max_replicas"):
+        FleetConfig(autoscale_min_replicas=3, autoscale_max_replicas=2)
+
+
+# ---------------------------------------------------------------------------
+# spawn / retire mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_spawns_only_after_patience(tiny_gpt):
+    fleet = _fleet(tiny_gpt, fleet_kw=dict(
+        autoscale_high_watermark=1.0, autoscale_patience=3,
+        autoscale_max_replicas=2))
+    for r in _reqs(8):
+        fleet.add_request(r)
+    # the breach must SUSTAIN through `patience` consecutive ticks
+    for tick in range(2):
+        fleet.step()
+        assert len(fleet.replicas) == 1, \
+            f"spawned after only {tick + 1} tick(s) of patience 3"
+    fleet.step()
+    assert len(fleet.replicas) == 2
+    assert fleet.stats()["num_spawned"] == 1
+    assert fleet.replicas[1].mode == "in_process"
+    fleet.run()
+    assert fleet.stats()["num_lost_requests"] == 0
+
+
+def test_autoscale_grows_and_shrinks_within_bounds(tiny_gpt):
+    obs = Observability(trace=False, metrics=False)
+    fleet = _fleet(tiny_gpt, obs=obs, fleet_kw=dict(
+        autoscale_high_watermark=1.0, autoscale_low_watermark=0.5,
+        autoscale_patience=2, autoscale_max_replicas=3))
+    for r in _reqs(10, new=16):
+        fleet.add_request(r)
+    sizes = []
+    while fleet.has_work:
+        fleet.step()
+        sizes.append(len(fleet._alive()))
+    st = fleet.stats()
+    assert max(sizes) <= 3                      # max bound held
+    assert min(sizes) >= 1                      # min bound held
+    assert max(sizes) > 1, "the burst never triggered a spawn"
+    assert sizes[-1] == 1, "the drained fleet did not shrink to min"
+    assert st["num_spawned"] >= 1 and st["num_retired"] >= 1
+    assert st["num_spawned"] - st["num_retired"] == 0
+    assert st["num_lost_requests"] == 0
+    assert len(fleet.run()) == 10               # every uid terminal
+    # steady state: an idle fleet at min size never flaps
+    before = (st["num_spawned"], st["num_retired"])
+    for _ in range(8):
+        fleet.step()
+    after = fleet.stats()
+    assert (after["num_spawned"], after["num_retired"]) == before
+    # recorder: every resize left its event
+    kinds = [e["kind"] for e in obs.recorder.tail()]
+    assert kinds.count("replica_spawn") == after["num_spawned"]
+    assert kinds.count("replica_retire") == after["num_retired"]
+
+
+def test_autoscale_bound_gates_the_streak(tiny_gpt):
+    """At max size with a still-breached watermark, the hi streak
+    stays DISARMED (not merely the action suppressed) — so the moment
+    capacity frees up the fleet does not instantly fire a stale
+    trigger."""
+    fleet = _fleet(tiny_gpt, fleet_kw=dict(
+        autoscale_high_watermark=0.5, autoscale_patience=2,
+        autoscale_max_replicas=2))
+    for r in _reqs(8, new=12):
+        fleet.add_request(r)
+    for _ in range(6):
+        fleet.step()
+    assert len(fleet.replicas) == 2             # pinned at max
+    assert fleet._autoscale_hi_streak == 0      # …with no primed trigger
+    fleet.run()
+    assert fleet.stats()["num_lost_requests"] == 0
+
+
+def test_autoscale_retire_uses_drain_and_migrate(tiny_gpt):
+    """Scale-down retires through drain_replica(retire=True): the
+    victim's live requests migrate to survivors, nothing is lost, and
+    the retired slot reads dead in stats."""
+    obs = Observability(trace=False, metrics=False)
+    fleet = _fleet(tiny_gpt, n=2, obs=obs, fleet_kw=dict(
+        autoscale_low_watermark=5.0, autoscale_patience=1,
+        autoscale_min_replicas=1))
+    for r in _reqs(3, new=10):
+        fleet.add_request(r)
+    fleet.step()                                # lo breached -> retire
+    st = fleet.stats()
+    assert st["num_retired"] == 1 and st["replicas_alive"] == 1
+    res = fleet.run(return_status=True)
+    assert sorted(res) == ["a0", "a1", "a2"]
+    assert fleet.stats()["num_lost_requests"] == 0
+    retire = [e for e in obs.recorder.tail()
+              if e["kind"] == "replica_retire"]
+    assert len(retire) == 1 and retire[0]["reason"] == "autoscale"
+
+
+def test_autoscale_trace_summary_line(tiny_gpt, tmp_path):
+    obs = Observability(trace=False, metrics=False)
+    fleet = _fleet(tiny_gpt, obs=obs, fleet_kw=dict(
+        autoscale_high_watermark=1.0, autoscale_low_watermark=0.5,
+        autoscale_patience=2, autoscale_max_replicas=2))
+    for r in _reqs(6, new=12):
+        fleet.add_request(r)
+    fleet.run()
+    dump_path = tmp_path / "autoscale_dump.json"
+    dump_path.write_text(json.dumps(obs.dump(), default=str))
+    spec = importlib.util.spec_from_file_location(
+        "_trace_summary",
+        Path(__file__).resolve().parents[1] / "tools" /
+        "trace_summary.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.summarize_file(str(dump_path))
+    assert "-- autoscaler:" in report
+    assert "spawns" in report and "retires" in report
+
+
+# ---------------------------------------------------------------------------
+# the never-firing identity cert
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet(tiny_gpt, fleet_kw):
+    fleet = _fleet(tiny_gpt, n=2, fleet_kw=fleet_kw, clock=lambda: 0.0,
+                   max_batch=2)
+    for r in _reqs(6, new=6):
+        fleet.add_request(r)
+    res = fleet.run(return_status=True)
+    return ({u: (tuple(r.tokens), r.status) for u, r in res.items()},
+            json.loads(json.dumps(fleet.stats(), sort_keys=True,
+                                  default=str)))
+
+
+def test_never_firing_autoscaler_is_bit_identical(tiny_gpt):
+    """Watermarks at ±inf arm the control loop on every tick but can
+    never fire it; the loop is pure load() reads, so EVERYTHING — the
+    outputs, the statuses, the full constant-clock stats() — matches
+    the static fleet bit for bit."""
+    static = _run_fleet(tiny_gpt, None)
+    armed = _run_fleet(tiny_gpt, dict(
+        autoscale_high_watermark=math.inf,
+        autoscale_low_watermark=-math.inf,
+        autoscale_patience=1, autoscale_max_replicas=8))
+    assert armed[0] == static[0]
+    assert armed[1] == static[1]

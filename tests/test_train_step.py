@@ -547,6 +547,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_fleet_kill_goodput_tok_per_sec",
         "serving_tiny_integrity_sdc_detection_latency_ticks",
         "serving_tiny_mesh_decode_tokens_per_sec",
+        "serving_tiny_process_kill_goodput_tok_per_sec",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -669,6 +670,30 @@ def test_bench_smoke_mode_every_section_rc0():
                ms["arms"]["mesh_1x2"]["reduction_ops"].values()), ms
     assert math.isfinite(ms["value"]) and ms["value"] > 0, ms
     assert math.isfinite(ms["vs_baseline"]) and ms["vs_baseline"] > 0, ms
+    # the process-replica arm (docs/fleet.md "Process replicas") must
+    # prove the out-of-process story end to end: a 1-process-replica
+    # fleet bit-identical to in-process, a child SIGKILLED for real
+    # mid-burst with zero lost accepted requests and a fresh child pid
+    # in the victim slot, the victims' p99 TTFT inside its bound, and
+    # the autoscaler ramp growing, shrinking back, and never flapping
+    # — a silently-in-process arm would be a quiet isolation lie
+    pr = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_process_kill_goodput_tok_per_sec"][0]
+    assert pr["identity_ok"] is True, pr
+    assert pr["zero_lost"] is True, pr
+    assert pr["num_lost_requests"] == 0, pr
+    assert pr["num_failovers"] >= 1, pr
+    assert pr["num_respawns"] >= 1, pr
+    assert pr["child_pid_fresh"] is True, pr
+    assert pr["num_accepted"] > 0, pr
+    assert (pr["victim_p99_ttft_ticks"]
+            <= pr["victim_p99_bound_ticks"]), pr
+    assert pr["autoscale_peak_replicas"] > 1, pr
+    assert pr["autoscale_num_spawned"] == pr["autoscale_num_retired"], pr
+    assert pr["autoscale_flap_free"] is True, pr
+    assert pr["status_counts"].get("finished", 0) > 0, pr
+    assert math.isfinite(pr["vs_baseline"]) and pr["value"] > 0, pr
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -687,7 +712,8 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_serving_kv_memory",
         "bench_serving_fleet", "bench_serving_integrity",
-        "bench_serving_mesh", "bench_train_step", "bench_obs_pipeline",
+        "bench_serving_mesh", "bench_serving_process",
+        "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():
         assert rec["status"] == "ok", rec

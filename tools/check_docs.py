@@ -37,6 +37,8 @@ OBS_KINDS = ("trace event type", "recorder event kind", "metric")
 FLEET_KINDS = ("FleetConfig field", "fleet stats() key")
 INTEGRITY_KINDS = ("integrity surface",)
 MESH_KINDS = ("mesh surface",)
+PROCESS_KINDS = ("process surface",)
+AUTOSCALE_KINDS = ("autoscale surface",)
 MESH_DOCS = ("docs/serving.md",)
 # the pod-scale mesh surface (knob + stats keys) must be named in the
 # "Mesh sharding" doc itself, docs/serving.md — same discipline as the
@@ -46,6 +48,22 @@ MESH_DOCS = ("docs/serving.md",)
 MESH_NAMES = (
     "mesh_shape",
     "mesh_devices", "mesh_model_axis",
+)
+# the process-replica surface (mode knob, RPC policy knobs, and the
+# wire-health counters) must be named in the "Process replicas" doc,
+# docs/fleet.md — each name cross-checked against the live
+# FleetConfig/stats surfaces so a rename breaks the lint.
+PROCESS_NAMES = (
+    "replica_mode", "rpc_timeout_s", "rpc_retries",
+    "num_rpc_retries", "num_rpc_timeouts",
+)
+# the autoscaler surface (watermarks + hysteresis knobs + the spawn/
+# retire tallies) — same discipline, also routed to docs/fleet.md.
+AUTOSCALE_NAMES = (
+    "autoscale_high_watermark", "autoscale_low_watermark",
+    "autoscale_patience", "autoscale_min_replicas",
+    "autoscale_max_replicas",
+    "num_spawned", "num_retired",
 )
 # the data-integrity surface (knobs + counters) must be named in the
 # "Data integrity" doc itself, docs/robustness.md — not merely
@@ -140,6 +158,22 @@ def collect_names():
                 "EngineConfig field or stats() key — update "
                 "tools/check_docs.py")
         names.append(("mesh surface", n))
+    # the process-replica + autoscaler surfaces: liveness-checked like
+    # the integrity surface, routed to docs/fleet.md specifically
+    for n in PROCESS_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"PROCESS_NAMES lists {n!r}, which is no longer a live "
+                "FleetConfig field or fleet stats() key — update "
+                "tools/check_docs.py")
+        names.append(("process surface", n))
+    for n in AUTOSCALE_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"AUTOSCALE_NAMES lists {n!r}, which is no longer a "
+                "live FleetConfig field or fleet stats() key — update "
+                "tools/check_docs.py")
+        names.append(("autoscale surface", n))
     return names
 
 
@@ -159,6 +193,8 @@ def main():
             text, where = robustness_text, ROBUSTNESS_DOCS
         elif kind in MESH_KINDS:
             text, where = mesh_text, MESH_DOCS
+        elif kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS:
+            text, where = fleet_text, FLEET_DOCS
         else:
             text, where = serving_text, SERVING_DOCS
         if name not in text:

@@ -122,6 +122,19 @@ def summarize(dump: Dict) -> str:
             f"(+{sum(int(e.get('adopted', 0)) for e in fails)} results "
             f"adopted from checkpoints), {len(migs)} migrations moving "
             f"{sum(int(e.get('requests', 0)) for e in migs)} requests")
+    spawns = [e for e in rec_events if e.get("kind") == "replica_spawn"]
+    retires = [e for e in rec_events
+               if e.get("kind") == "replica_retire"]
+    rpc_tos = [e for e in rec_events if e.get("kind") == "rpc_timeout"]
+    if spawns or retires or rpc_tos:
+        grew = ", ".join(f"r{e.get('replica')} @ {_fmt_s(e['t'])}"
+                         for e in spawns) or "-"
+        shrank = ", ".join(f"r{e.get('replica')} @ {_fmt_s(e['t'])}"
+                           for e in retires) or "-"
+        lines.append(
+            f"-- autoscaler: {len(spawns)} spawns ({grew}), "
+            f"{len(retires)} retires ({shrank}), "
+            f"{len(rpc_tos)} rpc timeouts")
     spills = [e for e in rec_events if e.get("kind") == "spill"]
     uploads = [e for e in rec_events if e.get("kind") == "spill_upload"]
     if spills or uploads:
