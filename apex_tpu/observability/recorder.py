@@ -48,6 +48,7 @@ RECORDER_EVENT_KINDS = (
     "replica_down",         # a fleet replica declared dead (or retired)
     "failover",             # the dead replica's requests re-homed
     "migrate",              # drain-and-migrate moved requests off a replica
+    "prefill_handoff",      # disaggregated prefill->decode handoff sweep
     "replica_spawn",        # the autoscaler grew the fleet by one replica
     "replica_retire",       # the autoscaler drained a replica away
     "rpc_timeout",          # a process-replica RPC exceeded its deadline

@@ -630,10 +630,15 @@ class EngineConfig:
                 f"got {self.kv_quantization!r}")
         # normalize (a caller's list restores as the identical
         # fingerprint value) and validate the mesh geometry against the
-        # backend; the num_heads divisibility half runs at engine
-        # construction, where the model is known
+        # backend, including the batch axis's lane/pool divisibility
+        # (a non-dividing split has no equal shard layout); the
+        # num_heads divisibility half runs at engine construction,
+        # where the model is known
         object.__setattr__(self, "mesh_shape",
-                           mesh_lib.validate_mesh_shape(self.mesh_shape))
+                           mesh_lib.validate_mesh_shape(
+                               self.mesh_shape,
+                               max_batch=self.max_batch,
+                               num_blocks=self.num_blocks))
         if self.spill_max_bytes is not None:
             if self.spill_max_bytes < 1:
                 raise ValueError(
@@ -1227,8 +1232,19 @@ class InferenceEngine:
                                  dtype=config.kv_dtype))
         else:
             self._block_weight = 1.0
+        # -- the batch axis (docs/serving.md, "The batch axis") --------
+        # B > 1 splits the max_batch decode lanes and the block pool
+        # into B contiguous shards (lane i -> shard i // lanes_per_
+        # shard; block b -> shard b // blocks_per_shard). The allocator
+        # enforces shard residency host-side; the sharded programs
+        # localize tables by subtracting the shard base. B == 1 keeps
+        # every code path byte-identical to the pre-batch-axis engine.
+        self._batch_shards = config.mesh_shape[0]
+        self._lanes_per_shard = config.max_batch // self._batch_shards
+        self._blocks_per_shard = config.num_blocks // self._batch_shards
         self.allocator = BlockAllocator(config.num_blocks,
-                                        block_weight=self._block_weight)
+                                        block_weight=self._block_weight,
+                                        num_shards=self._batch_shards)
         # the host-RAM spill tier (docs/serving.md memory tiers):
         # evicted/flushed prefix blocks copy to this bounded host
         # store; _admit re-admits matches by device upload
@@ -1258,7 +1274,8 @@ class InferenceEngine:
             # into the pool (its own jit slot — the prefill/decode
             # compile-count contract is untouched)
             self._upload = jax.jit(
-                self._upload_impl,
+                (self._upload_sharded_impl if self._batch_shards > 1
+                 else self._upload_impl),
                 donate_argnums=(0,) if config.donate_cache else (),
                 **self._cache_out_kw())
         self.slots: List[Optional[_Slot]] = [None] * config.max_batch
@@ -1303,6 +1320,13 @@ class InferenceEngine:
         self._num_checkpoints = 0
         self._num_migrated_in = 0
         self._num_migrated_out = 0
+        # the arrival PRNG identity of each uid this engine exported,
+        # retained CLEAN on this side of the wire: when a record rots
+        # in transit and the target refuses it, the router re-injects
+        # the request fresh — and only this index lets the recompute
+        # re-draw the same sampled tokens (sampling is arrival-keyed;
+        # the corrupted record's own "arrival" field is untrustworthy)
+        self._exported_arrivals: Dict[str, int] = {}
         # -- overload protection (docs/robustness.md) ------------------
         self._num_ticks = 0
         self._queue_depth_peak = 0
@@ -1373,14 +1397,27 @@ class InferenceEngine:
         # (zero-proposal lanes run through it as single-token steps, so
         # no second "fallback" program ever exists).
         donate = (1,) if config.donate_cache else ()
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=donate,
+        # B > 1 swaps in the batch-axis sharded wrappers (same program
+        # slots, same arg signatures, one compilation each — the
+        # compile-count contract is shape-based and unchanged); B == 1
+        # keeps the exact pre-batch-axis callables, so the (1, 1)
+        # bit-identity certification never sees the wrapper.
+        sharded = self._batch_shards > 1
+        prefill_fn = (self._prefill_sharded_impl if sharded
+                      else self._prefill_impl)
+        if config.spec_tokens > 0:
+            decode_fn = (self._spec_decode_sharded_impl if sharded
+                         else self._spec_decode_impl)
+        else:
+            decode_fn = (self._decode_sharded_impl if sharded
+                         else self._decode_impl)
+        self._prefill = jax.jit(prefill_fn, donate_argnums=donate,
                                 **self._pair_out_kw())
-        self._decode = jax.jit(
-            self._spec_decode_impl if config.spec_tokens > 0
-            else self._decode_impl,
-            donate_argnums=donate, **self._pair_out_kw())
+        self._decode = jax.jit(decode_fn, donate_argnums=donate,
+                               **self._pair_out_kw())
         self._cow = jax.jit(
-            copy_block, donate_argnums=(0,) if config.donate_cache else (),
+            self._cow_sharded_impl if sharded else copy_block,
+            donate_argnums=(0,) if config.donate_cache else (),
             **self._cache_out_kw())
 
     def _pair_out_kw(self) -> Dict[str, object]:
@@ -1493,7 +1530,11 @@ class InferenceEngine:
         rejection stranded — happens at drain time via
         ``BlockAllocator.trim_to``.)
         """
-        B = self.config.max_batch
+        # lane count from the INPUT (not config.max_batch): under the
+        # batch-axis vmap each shard verifies its own lane group; the
+        # unsharded program passes all max_batch lanes, so the traced
+        # value is unchanged there
+        B = tokens.shape[0]
         P = self.config.spec_tokens + 1
         act = budgets > 0
         q_ids = jnp.concatenate([tokens[:, None], drafts], axis=1)
@@ -1524,6 +1565,179 @@ class InferenceEngine:
                      - is_eos.astype(jnp.int32)) > 0
         keep = within & ~after_eos & act[:, None]
         return cache, jnp.where(keep, emitted, jnp.int32(-1))
+
+    # -- the batch-axis sharded programs (docs/serving.md) ----------------
+    #
+    # At mesh_shape = (B, M) with B > 1 the jitted programs wrap the
+    # (1, M) bodies above in a per-shard vmap: the pool's block axis
+    # reshapes [L, N, ...] -> [B, L, N/B, ...] exactly on the shard
+    # boundaries the NamedSharding put there (a local reshape — GSPMD
+    # inserts nothing), lane arrays reshape [max_batch] -> [B, N/B
+    # lanes], and the GLOBAL block-table ids localize per shard. The
+    # allocator's shard-residency invariant means the owning shard's
+    # entries land in [0, blocks_per_shard) and every foreign entry
+    # clamps to the out-of-bounds sentinel, where the scatter drops
+    # and the gather reads already-masked garbage — so non-owners need
+    # no masking and the whole split lowers collective-free (the
+    # audit_collectives batch contract). The clamp is explicit because
+    # jnp indexing WRAPS negative traced indices Python-style; a raw
+    # base subtraction would alias a foreign block into a valid local
+    # id.
+
+    def _cache_split(self, cache):
+        B = self._batch_shards
+
+        def split(x):
+            y = x.reshape((x.shape[0], B, x.shape[1] // B) + x.shape[2:])
+            return jnp.moveaxis(y, 1, 0)
+
+        return jax.tree.map(split, cache)
+
+    def _cache_merge(self, scache):
+        B = self._batch_shards
+
+        def merge(x):
+            y = jnp.moveaxis(x, 0, 1)
+            return y.reshape((y.shape[0], B * y.shape[2]) + y.shape[3:])
+
+        return jax.tree.map(merge, scache)
+
+    def _localize_tables(self, tables):
+        """``[B, lanes, M]``-shaped global-id tables -> per-shard local
+        ids: in-range entries subtract the shard base, everything else
+        (foreign shards' blocks, the host's ``num_blocks`` sentinel)
+        becomes the local out-of-bounds id ``blocks_per_shard``."""
+        Nl = self._blocks_per_shard
+        bases = (jnp.arange(self._batch_shards, dtype=jnp.int32)
+                 * Nl)[:, None, None]
+        local = tables - bases
+        return jnp.where((local >= 0) & (local < Nl), local,
+                         jnp.int32(Nl))
+
+    def _prefill_sharded_impl(self, params, cache, ids, positions,
+                              seq_len, write_start, sample_idx, table,
+                              key, temp, top_k, top_p):
+        """B > 1 prefill: every shard traces the same ``[1, C]`` chunk
+        (inputs broadcast across the vmap), but only the shard owning
+        the slot's blocks sees in-range localized table entries — its
+        scatter writes the chunk and its attention reads real K/V;
+        every other shard's writes drop and its sampled token is
+        deterministic garbage the host discards. Returns ``[B]``
+        tokens (batch-sharded); the host keeps index ``lane_shard``."""
+        B = self._batch_shards
+        scache = self._cache_split(cache)
+        tbl = self._localize_tables(
+            jnp.broadcast_to(table, (B,) + table.shape))
+
+        def one(c, tb):
+            return self._prefill_impl(params, c, ids, positions,
+                                      seq_len, write_start, sample_idx,
+                                      tb, key, temp, top_k, top_p)
+
+        scache, tok = jax.vmap(one)(scache, tbl)
+        return self._cache_merge(scache), tok.reshape(B)
+
+    def _decode_sharded_impl(self, params, cache, tokens, tables,
+                             context_lens, budgets, gen_counts, eos_ids,
+                             lane_keys, temp, top_k, top_p):
+        """B > 1 decode: each shard scans its own lane group against
+        its own pool range. Tokens return ``[max_batch, K]`` in the
+        global lane order (lane = shard * lanes_per_shard + local), so
+        the host drain is byte-identical to the unsharded program's."""
+        B, Lp = self._batch_shards, self._lanes_per_shard
+        scache = self._cache_split(cache)
+        tbl = self._localize_tables(tables.reshape(B, Lp, -1))
+
+        def one(c, tb, tok, cx, bud, gc, eo, ky, tp, tk, tpp):
+            return self._decode_impl(params, c, tok, tb, cx, bud, gc,
+                                     eo, ky, tp, tk, tpp)
+
+        scache, toks = jax.vmap(one)(
+            scache, tbl, tokens.reshape(B, Lp),
+            context_lens.reshape(B, Lp), budgets.reshape(B, Lp),
+            gen_counts.reshape(B, Lp), eos_ids.reshape(B, Lp),
+            lane_keys.reshape((B, Lp) + lane_keys.shape[1:]),
+            temp.reshape(B, Lp), top_k.reshape(B, Lp),
+            top_p.reshape(B, Lp))
+        return (self._cache_merge(scache),
+                toks.reshape((self.config.max_batch,) + toks.shape[2:]))
+
+    def _spec_decode_sharded_impl(self, params, cache, tokens, drafts,
+                                  draft_lens, tables, context_lens,
+                                  budgets, gen_counts, eos_ids,
+                                  lane_keys, temp, top_k, top_p):
+        """B > 1 draft-and-verify: the verify program vmapped over the
+        shard axis, same conventions as the sharded scan decode."""
+        B, Lp = self._batch_shards, self._lanes_per_shard
+        scache = self._cache_split(cache)
+        tbl = self._localize_tables(tables.reshape(B, Lp, -1))
+
+        def one(c, tok, dr, dl, tb, cx, bud, gc, eo, ky, tp, tk, tpp):
+            return self._spec_decode_impl(params, c, tok, dr, dl, tb,
+                                          cx, bud, gc, eo, ky, tp, tk,
+                                          tpp)
+
+        scache, toks = jax.vmap(one)(
+            scache, tokens.reshape(B, Lp),
+            drafts.reshape((B, Lp) + drafts.shape[1:]),
+            draft_lens.reshape(B, Lp), tbl,
+            context_lens.reshape(B, Lp), budgets.reshape(B, Lp),
+            gen_counts.reshape(B, Lp), eos_ids.reshape(B, Lp),
+            lane_keys.reshape((B, Lp) + lane_keys.shape[1:]),
+            temp.reshape(B, Lp), top_k.reshape(B, Lp),
+            top_p.reshape(B, Lp))
+        return (self._cache_merge(scache),
+                toks.reshape((self.config.max_batch,) + toks.shape[2:]))
+
+    def _cow_sharded_impl(self, cache, src, dst):
+        """B > 1 copy-on-write: the owning shard (src and dst share a
+        shard — the allocator allocates the private copy on the slot's
+        shard) copies localized ids; every other shard targets the
+        out-of-bounds id, where the explicit ``mode="drop"`` discards
+        the write."""
+        B, Nl = self._batch_shards, self._blocks_per_shard
+        scache = self._cache_split(cache)
+        src = jnp.asarray(src, jnp.int32)
+        dst = jnp.asarray(dst, jnp.int32)
+        shard_ids = jnp.arange(B, dtype=jnp.int32)
+        own = shard_ids == src // Nl
+        src_l = jnp.where(own, src % Nl, jnp.int32(Nl))
+        dst_l = jnp.where(own, dst % Nl, jnp.int32(Nl))
+
+        def one(c, s, d):
+            # copy_block's shape, with explicit drop modes: the
+            # non-owning shards' OOB src clamps (reads garbage) and
+            # OOB dst drops (writes nothing)
+            s = jnp.minimum(s, jnp.int32(Nl - 1))
+            out = KVCache(
+                k=c.k.at[:, d].set(c.k[:, s], mode="drop"),
+                v=c.v.at[:, d].set(c.v[:, s], mode="drop"))
+            if c.k_scale is not None:
+                out = out._replace(
+                    k_scale=c.k_scale.at[:, d].set(c.k_scale[:, s],
+                                                   mode="drop"),
+                    v_scale=c.v_scale.at[:, d].set(c.v_scale[:, s],
+                                                   mode="drop"))
+            return out
+
+        return self._cache_merge(jax.vmap(one)(scache, src_l, dst_l))
+
+    def _upload_sharded_impl(self, cache, ids, k_blk, v_blk, *scales):
+        """B > 1 spill upload: the ``[max_blocks_per_seq]`` global ids
+        localize per shard (foreign/padding entries clamp out of
+        bounds and drop), payloads broadcast — each shard scatters
+        only the rows it owns."""
+        B, Nl = self._batch_shards, self._blocks_per_shard
+        scache = self._cache_split(cache)
+        bases = (jnp.arange(B, dtype=jnp.int32) * Nl)[:, None]
+        local = jnp.asarray(ids, jnp.int32)[None, :] - bases
+        ids_l = jnp.where((local >= 0) & (local < Nl), local,
+                          jnp.int32(Nl))
+
+        def one(c, i):
+            return self._upload_impl(c, i, k_blk, v_blk, *scales)
+
+        return self._cache_merge(jax.vmap(one)(scache, ids_l))
 
     # -- host-side scheduling ---------------------------------------------
 
@@ -1769,6 +1983,24 @@ class InferenceEngine:
         placement, batch composition, ``decode_steps``, and
         preemption/resume (the re-queued entry keeps its arrival)."""
         return jax.random.fold_in(self._key, entry.arrival)
+
+    def _lane_shard(self, lane: int) -> int:
+        """The batch-axis shard owning a lane (contiguous lane groups:
+        ``lane // lanes_per_shard``). Always 0 at ``B == 1``."""
+        return lane // self._lanes_per_shard
+
+    def _admit_lane_order(self):
+        """The free-lane scan order of ``_admit``: plain index order
+        unsharded (bit-identical to the pre-batch-axis engine); at
+        ``B > 1``, round-robin ACROSS shards (lane 0 of every shard,
+        then lane 1, ...) so admissions spread residents — and pool
+        pressure — evenly over the data-parallel shards instead of
+        filling shard 0 first."""
+        if self._batch_shards == 1:
+            return range(self.config.max_batch)
+        return (s * self._lanes_per_shard + l
+                for l in range(self._lanes_per_shard)
+                for s in range(self._batch_shards))
 
     def _invalidate_lanes(self) -> None:
         """Slot composition changed (admit/start/finish/preempt): both
@@ -2351,9 +2583,15 @@ class InferenceEngine:
         admitted = 0
         below = self._admission_priority_limit()
         skip: set = set()
-        for idx in range(self.config.max_batch):
+        for idx in self._admit_lane_order():
             if self.slots[idx] is not None:
                 continue
+            # at B > 1 every allocation/match of this lane is scoped to
+            # its shard's pool range (the shard-residency invariant the
+            # sharded programs rely on); None = the whole pool,
+            # bit-identical to the pre-batch-axis engine
+            shard = (self._lane_shard(idx) if self._batch_shards > 1
+                     else None)
             while True:
                 entry = self.waiting.head(below=below, skip=skip)
                 if entry is None:
@@ -2368,7 +2606,8 @@ class InferenceEngine:
                     if entry.hashes is None:
                         entry.hashes = self._seq_hashes(seq)
                     hashes = entry.hashes
-                    matched = self.allocator.lookup_prefix(hashes)
+                    matched = self.allocator.lookup_prefix(hashes,
+                                                           shard=shard)
                 # the spill tier extends the device match: the run of
                 # chain hashes CONTINUING the device prefix that the
                 # host store still holds re-admits by upload instead
@@ -2431,8 +2670,18 @@ class InferenceEngine:
                 # count toward the capacity the tail can draw from
                 reviving = sum(1 for b in matched
                                if self.allocator.refcount(b) == 0)
-                if (need > self.allocator.num_free
-                        + self.allocator.num_cached - reviving):
+                if shard is None:
+                    capacity = (self.allocator.num_free
+                                + self.allocator.num_cached)
+                else:
+                    capacity = (self.allocator.free_in_shard(shard)
+                                + self.allocator.cached_in_shard(shard))
+                if need > capacity - reviving:
+                    if shard is not None:
+                        # this SHARD cannot fit the head; another
+                        # shard's free lane may — head-of-line blocking
+                        # is per shard at B > 1
+                        break
                     # head-of-line blocking: don't let a small request
                     # starve the head
                     return admitted
@@ -2481,7 +2730,8 @@ class InferenceEngine:
                         spill_run, n_up = ok_run, len(ok_run)
                         m_tok = (len(matched) + n_up) * bs
                 if spill_run:
-                    up_blocks = self.allocator.alloc(n_up, tenant=tenant)
+                    up_blocks = self.allocator.alloc(n_up, tenant=tenant,
+                                                     shard=shard)
                     self.cache = self._upload(
                         self.cache,
                         *self._upload_args(up_blocks, payloads))
@@ -2503,7 +2753,8 @@ class InferenceEngine:
                     self._spill_misses += (len(hashes) - len(matched)
                                            - n_up)
                 blocks = matched + up_blocks \
-                    + (self.allocator.alloc(tail, tenant=tenant)
+                    + (self.allocator.alloc(tail, tenant=tenant,
+                                            shard=shard)
                        if tail else [])
                 self._prefix_lookup_blocks += len(hashes)
                 self._prefix_hit_blocks += len(matched)
@@ -2587,7 +2838,13 @@ class InferenceEngine:
                 jnp.asarray([(L - 1) - start], jnp.int32),    # sample_idx
                 device_block_table(table, self.config.num_blocks),
                 self._request_key(slot.entry), temp, top_k, top_p)
-            tok0 = int(tok[0])      # the fetch is part of service time
+            # the owning shard's sampled token (index 0 == the whole
+            # program's single token at B == 1; at B > 1 the sharded
+            # prefill returns one candidate per shard and only the
+            # lane's shard attended over real K/V)
+            tok0 = int(tok[self._lane_shard(idx)
+                           if self._batch_shards > 1 else 0])
+            # the fetch is part of service time
             attempt_s[0] = self._clock() - t0
             attempt_s[1] = t0
             return cache, tok0
@@ -2723,8 +2980,14 @@ class InferenceEngine:
         inverts priority, and within the class youngest-first
         guarantees the oldest request always progresses, so the system
         drains. Returns False when the requester is the only lane
-        (nothing to free — the pool is simply too small for it)."""
-        cand = [i for i, s in enumerate(self.slots) if s is not None]
+        (nothing to free — the pool is simply too small for it). At
+        ``B > 1`` victims come only from the REQUESTER'S shard: a
+        foreign shard's lane frees blocks the requester's shard-scoped
+        allocation can never draw from."""
+        cand = [i for i, s in enumerate(self.slots) if s is not None
+                and (self._batch_shards == 1
+                     or self._lane_shard(i)
+                     == self._lane_shard(requester))]
         if len(cand) <= 1:
             return False
         idx = max(cand, key=self._yield_key)
@@ -2844,7 +3107,11 @@ class InferenceEngine:
                         continue
                     try:
                         slot.blocks.extend(
-                            self.allocator.alloc(grow, tenant=tenant))
+                            self.allocator.alloc(
+                                grow, tenant=tenant,
+                                shard=(self._lane_shard(i)
+                                       if self._batch_shards > 1
+                                       else None)))
                         self._invalidate_tables()
                     except CacheOutOfBlocks:
                         if not self._preempt_for(i):
@@ -2870,9 +3137,13 @@ class InferenceEngine:
                 try:
                     # CoW rides outside the tenant quota check: it nets
                     # +1 - (shared fraction) charge, bounded by the
-                    # same door-validated worst case
+                    # same door-validated worst case. The private copy
+                    # lands on the slot's shard (src and dst must share
+                    # one for the sharded copy program).
                     nb = self.allocator.alloc(
-                        1, tenant=slot.request.tenant)[0]
+                        1, tenant=slot.request.tenant,
+                        shard=(self._lane_shard(i)
+                               if self._batch_shards > 1 else None))[0]
                 except CacheOutOfBlocks:
                     if not self._preempt_for(i):
                         if self._obs is not None:
@@ -3499,6 +3770,18 @@ class InferenceEngine:
                 n += 1
         return n
 
+    def decoding_uids(self) -> List[str]:
+        """Uids of resident slots whose prefill has COMPLETED (first
+        token known, decode phase entered), in admission order. The
+        disaggregated fleet's handoff signal (docs/fleet.md,
+        "Disaggregated roles"): a prefill-specialist replica's router
+        migrates exactly these to a decode specialist each tick —
+        waiting entries and mid-prefill lanes stay put. Read-only,
+        host-side, no sync."""
+        started = [(s.admit_seq, s.request.uid) for s in self.slots
+                   if s is not None and s.started]
+        return [uid for _, uid in sorted(started)]
+
     def export_requests(self, uids: Optional[Sequence[str]] = None
                         ) -> List[Dict]:
         """Drain-and-migrate EXPORT: remove the given waiting/resident
@@ -3539,6 +3822,11 @@ class InferenceEngine:
                 lambda e: want is None or e.request.uid in want):
             records.append(self._entry_record(entry, now))
             self._release_exported(entry.request)
+        # stash each record's arrival identity BEFORE the chaos site
+        # can touch the caller's copy (see _exported_arrivals)
+        for rec in records:
+            self._exported_arrivals[str(rec["uid"])] = \
+                int(rec["arrival"])
         # each record is sealed for the wire (import_requests verifies
         # it), THEN run through the "export" chaos site — one fire per
         # record, so a seeded plan can rot exactly the record it means
@@ -3547,6 +3835,29 @@ class InferenceEngine:
                    for rec in records]
         self._num_migrated_out += len(records)
         return records
+
+    def drop_stream_events(self, uid: str) -> int:
+        """Discard this engine's UNDRAINED stream events for ``uid`` —
+        the refused-import recompute's companion: the re-injected
+        request re-derives (and re-emits) every token past the
+        router's delivered watermark, so stale copies the router never
+        drained would otherwise arrive twice — once stale, once
+        re-derived — and shift every later position in the delivered
+        ledger. Returns how many events were dropped."""
+        uid = str(uid)
+        before = len(self._stream)
+        self._stream = deque(ev for ev in self._stream
+                             if ev[0] != uid)
+        return before - len(self._stream)
+
+    def exported_arrival(self, uid: str) -> Optional[int]:
+        """The arrival PRNG index this engine last exported for
+        ``uid`` — the clean, source-side copy the router's
+        refused-import recompute reads so a re-injected request keeps
+        its sampled-token identity (``None`` when the uid never left
+        through :meth:`export_requests`)."""
+        v = self._exported_arrivals.get(str(uid))
+        return None if v is None else int(v)
 
     def _release_exported(self, request: Request) -> None:
         """Forget an exported request WITHOUT a terminal transition:
@@ -3626,6 +3937,9 @@ class InferenceEngine:
                 arrival = self._arrival_count
             arrival = int(arrival)
             self._arrival_count = max(self._arrival_count, arrival + 1)
+            # the uid lives HERE now: any stale source-side export
+            # stamp of ours is superseded by this admission
+            self._exported_arrivals.pop(req.uid, None)
             self._live_uids.add(req.uid)
             self._tenant_seen.add(req.tenant)
             self.waiting.append(_QueueEntry(
@@ -4197,10 +4511,14 @@ class InferenceEngine:
         each block's tenant refs must equal the residents referencing
         it, split by their tenants — the certification that aborts,
         quota sheds, and preemptions reclaimed exactly what they
-        owned."""
+        owned. With a sharded ``batch`` axis (``mesh_shape[0] > 1``)
+        every resident's blocks must additionally live on its LANE's
+        shard — the invariant the sharded programs' subtraction
+        localization silently depends on (a foreign block would read
+        masked garbage, not raise)."""
         expected: Dict[int, int] = {}
         expected_tenants: Dict[int, Dict[str, int]] = {}
-        for slot in self.slots:
+        for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
             t = slot.request.tenant
@@ -4208,6 +4526,14 @@ class InferenceEngine:
                 expected[b] = expected.get(b, 0) + 1
                 per = expected_tenants.setdefault(b, {})
                 per[t] = per.get(t, 0) + 1
+                if (self._batch_shards > 1
+                        and self.allocator.shard_of(b)
+                        != self._lane_shard(i)):
+                    raise AssertionError(
+                        f"slot {i} (shard {self._lane_shard(i)}) holds "
+                        f"block {b} on shard "
+                        f"{self.allocator.shard_of(b)}: batch-axis "
+                        "shard residency violated")
         self.allocator.check_integrity(
             expected_refcounts=expected,
             expected_tenant_refs=expected_tenants)
@@ -4233,6 +4559,7 @@ class InferenceEngine:
             "mesh_devices": (self.config.mesh_shape[0]
                              * self.config.mesh_shape[1]),
             "mesh_model_axis": self.config.mesh_shape[1],
+            "mesh_batch_axis": self.config.mesh_shape[0],
             "num_prefills": self._num_prefills,
             "num_prefill_chunks": self._num_prefill_chunks,
             "num_decode_dispatches": self._num_decode_dispatches,

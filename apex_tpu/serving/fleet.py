@@ -227,6 +227,26 @@ class FleetConfig:
     autoscale_patience: int = 3
     autoscale_min_replicas: int = 1
     autoscale_max_replicas: Optional[int] = None
+    # -- disaggregated prefill/decode roles (docs/fleet.md,
+    # "Disaggregated roles") ------------------------------------------
+    # None (the default): every replica is colocated ("mixed" — runs
+    # prefill AND decode, exactly today's fleet, certified
+    # bit-identical). A sequence of "prefill"/"decode", one per
+    # replica (at least one of each), splits the fleet into
+    # specialists: new prompts place onto prefill replicas by queue
+    # depth, a prefill replica's started requests hand off each tick
+    # to a decode replica through the checksummed migration transport
+    # (KV payloads ride the spill tier — the decode side re-admits as
+    # a prefix hit instead of recomputing), and decode placement
+    # ranks decode replicas only (affinity + load; prefill
+    # specialists are never probed). Roles are PLACEMENT policy, not
+    # capability: failover falls back to any survivor when a role
+    # group empties, preserving the zero-lost contract. Requires
+    # EngineConfig.enable_prefix_caching (the handoff's transport and
+    # the decode side's prefix-hit admit are both keyed by the chain
+    # hashes); a spill tier (spill_max_bytes) makes the handoff carry
+    # KV instead of recomputing, and is strongly recommended.
+    replica_roles: Optional[Sequence[str]] = None
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -294,6 +314,24 @@ class FleetConfig:
                 f"({self.autoscale_max_replicas}) must be >= "
                 f"autoscale_min_replicas "
                 f"({self.autoscale_min_replicas})")
+        if self.replica_roles is not None:
+            roles = tuple(self.replica_roles)
+            object.__setattr__(self, "replica_roles", roles)
+            if len(roles) != self.num_replicas:
+                raise ValueError(
+                    f"replica_roles must list one role per replica "
+                    f"({self.num_replicas}), got {len(roles)}")
+            bad = [r for r in roles if r not in ("prefill", "decode")]
+            if bad:
+                raise ValueError(
+                    f"replica_roles entries must be 'prefill' or "
+                    f"'decode', got {bad[0]!r}")
+            for need in ("prefill", "decode"):
+                if need not in roles:
+                    raise ValueError(
+                        f"replica_roles needs at least one {need!r} "
+                        "replica: a disaggregated fleet without one "
+                        "can accept work it can never finish")
 
 
 @dataclasses.dataclass
@@ -308,6 +346,9 @@ class _Replica:
     routed: int = 0
     error: Optional[str] = None
     mode: str = "in_process"
+    # "mixed" (colocated, the default), or the specialist role from
+    # FleetConfig.replica_roles; a respawn into the slot keeps it
+    role: str = "mixed"
 
 
 class FleetRouter:
@@ -403,6 +444,21 @@ class FleetRouter:
         # router's own machinery — placement, checkpoints, migration,
         # SDC cross-checks — is host-side and mesh-agnostic.
         self.mesh = build_mesh(engine_config.mesh_shape)
+        # -- disaggregated roles (docs/fleet.md, "Disaggregated
+        # roles"): the per-slot role assignment, parallel to
+        # self.replicas (autoscaled slots append; respawns keep the
+        # slot's role). Colocated fleets run every slot as "mixed".
+        self._roles_enabled = self.config.replica_roles is not None
+        if self._roles_enabled and not engine_config.enable_prefix_caching:
+            raise ValueError(
+                "replica_roles requires "
+                "EngineConfig.enable_prefix_caching: the prefill->"
+                "decode handoff transports KV through the chain-hash-"
+                "keyed prefix index, and the decode side admits the "
+                "handoff as a prefix hit")
+        self._roles: List[str] = (list(self.config.replica_roles)
+                                  if self._roles_enabled
+                                  else ["mixed"] * n)
         self.replicas: List[_Replica] = [self._spawn(i)
                                          for i in range(n)]
         # fleet-wide request tracking: owner replica per live uid, the
@@ -462,6 +518,17 @@ class FleetRouter:
         self._num_rpc_timeouts = 0
         self._autoscale_hi_streak = 0
         self._autoscale_lo_streak = 0
+        # per-role watermark streaks (colocated fleets have the single
+        # role "mixed", which mirrors into the scalar streaks above —
+        # the signal and behavior reduce exactly to the pre-role
+        # autoscaler)
+        self._as_hi_streaks: Dict[str, int] = {}
+        self._as_lo_streaks: Dict[str, int] = {}
+        # -- disaggregation counters (docs/fleet.md) --------------------
+        self._num_handoffs = 0
+        self._num_handoff_requests = 0
+        self._num_handoff_bytes = 0
+        self._num_affinity_probes_skipped = 0
         self._sdc_enabled = \
             self.config.sdc_check_interval_ticks is not None
         self._sdc_arrivals: Dict[str, int] = {}
@@ -470,6 +537,7 @@ class FleetRouter:
         self._sdc_seq = 0
 
     def _spawn(self, idx: int) -> _Replica:
+        role = self._roles[idx]
         if self.config.replica_mode == "process":
             eng = ProcessReplica(
                 self.engine_config, self._model_spec,
@@ -480,11 +548,12 @@ class FleetRouter:
                 expect_params_checksum=self._params_checksum,
                 on_retry=self._note_rpc_retry,
                 on_timeout=lambda i=idx: self._note_rpc_timeout(i))
-            return _Replica(engine=eng, mode="process")
+            return _Replica(engine=eng, mode="process", role=role)
         return _Replica(engine=InferenceEngine(
             self.model, self.params, self.engine_config,
             drafter=self._drafters[idx], faults=self._faults[idx],
-            clock=self._clock, mesh=self.mesh), mode="in_process")
+            clock=self._clock, mesh=self.mesh), mode="in_process",
+            role=role)
 
     def _note_rpc_retry(self) -> None:
         self._num_rpc_retries += 1
@@ -503,7 +572,8 @@ class FleetRouter:
     def _seq_hashes(self, tokens: Sequence[int]) -> List[str]:
         return seq_block_hashes(tokens, self.engine_config.block_size)
 
-    def _ranked(self, seq: Sequence[int]) -> List[Tuple[int, int]]:
+    def _ranked(self, seq: Sequence[int],
+                stage: Optional[str] = None) -> List[Tuple[int, int]]:
         """Alive replicas as ``(index, matched_blocks)``, best placement
         first (docs/fleet.md, placement score)::
 
@@ -517,11 +587,40 @@ class FleetRouter:
         replica's backlog weighs more), over ``max_batch``. Ties break
         toward the smaller backlog, then the lower index —
         deterministic, and exactly "replica 0" for a 1-replica fleet.
-        """
+
+        With ``FleetConfig.replica_roles`` set, placement is
+        TWO-STAGE (docs/fleet.md, "Disaggregated roles"): stage
+        ``"prefill"`` (new prompts, waiting-entry re-homes) ranks the
+        prefill specialists by backlog alone — no affinity probes; a
+        specialist fleet's prefill side holds no stable prefix set
+        worth scoring — and stage ``"decode"`` (handoffs, mid-decode
+        re-homes) ranks the decode specialists by the full
+        affinity+load score, SKIPPING the probe of every prefill
+        specialist (counted in ``stats()["num_affinity_probes_"
+        "skipped"]``). A stage whose role group has no alive member
+        falls back to ranking every survivor — roles are placement
+        policy, not capability, and the zero-lost contract outranks
+        specialization. Colocated fleets ignore ``stage`` entirely
+        (bit-identical to the single-stage router)."""
         alive = self._alive()
         if not alive:
             raise FleetFailedError(
                 "no replica alive to route to (respawn is off)")
+        if self._roles_enabled and stage is not None:
+            pool = [(i, rep) for i, rep in alive
+                    if self.replicas[i].role == stage]
+            if pool and stage == "prefill":
+                loads = {i: rep.engine.load() for i, rep in pool}
+                order = sorted(
+                    (ld["queue_depth"] + ld["active_slots"], i)
+                    for i, ld in loads.items())
+                return [(i, 0) for _, i in order]
+            if pool and stage == "decode":
+                self._num_affinity_probes_skipped += (len(alive)
+                                                      - len(pool))
+                alive = pool
+            # an empty role group (every specialist of that role is
+            # down): degrade to the full-survivor ranking below
         hashes = self._seq_hashes(seq)
         loads = {i: rep.engine.load() for i, rep in alive}
         svc = {i: (ld["ewma_prefill_dispatch_s"]
@@ -645,7 +744,8 @@ class FleetRouter:
                 f"request {uid!r} throttled: tenant "
                 f"{request.tenant!r} {reason}")
         placed = None
-        for idx, matched in self._ranked(list(request.prompt)):
+        for idx, matched in self._ranked(list(request.prompt),
+                                         stage="prefill"):
             try:
                 arrival = self.replicas[idx].engine.add_request(request)
             except QueueFullError:
@@ -721,8 +821,13 @@ class FleetRouter:
         ``health_patience`` no-progress streak — with failover), then
         drain every replica's stream events and terminal results into
         the router's fleet-wide maps. Returns whether anything
-        progressed (a failover counts: it moved requests)."""
+        progressed (a failover counts: it moved requests). With
+        disaggregated roles the tick OPENS with the handoff sweep —
+        started requests leave the prefill specialists before this
+        tick's stepping, operating on last tick's fully-drained
+        state."""
         self._num_ticks += 1
+        self._handoff_tick()
         progressed = False
         for i in range(len(self.replicas)):
             rep = self.replicas[i]
@@ -1082,42 +1187,71 @@ class FleetRouter:
         alive = self._alive()
         if not alive:
             return
-        try:
-            depth = sum(rep.engine.load()["queue_depth"]
-                        for _, rep in alive) / len(alive)
-        except ReplicaUnavailableError:
-            return      # a child died mid-read; next step() contains it
+        # the signal is PER-ROLE (docs/fleet.md, "Disaggregated
+        # roles"): mean queue depth over the alive replicas of each
+        # role, so a prefill backlog is never masked by idle decode
+        # replicas (or vice versa). A colocated fleet has the single
+        # role "mixed" — one group, the exact pre-role signal.
+        groups: Dict[str, List] = {}
+        for i, rep in alive:
+            groups.setdefault(rep.role, []).append((i, rep))
         maxr = self.config.autoscale_max_replicas
         can_grow = maxr is None or len(alive) < maxr
-        can_shrink = len(alive) > self.config.autoscale_min_replicas
-        self._autoscale_hi_streak = (
-            self._autoscale_hi_streak + 1
-            if (hi is not None and depth > hi and can_grow) else 0)
-        self._autoscale_lo_streak = (
-            self._autoscale_lo_streak + 1
-            if (lo is not None and depth < lo and can_shrink) else 0)
-        if self._autoscale_hi_streak >= self.config.autoscale_patience:
-            self._autoscale_hi_streak = 0
-            self._autoscale_lo_streak = 0
-            self._scale_up()
-        elif self._autoscale_lo_streak >= self.config.autoscale_patience:
-            self._autoscale_hi_streak = 0
-            self._autoscale_lo_streak = 0
-            self._scale_down()
+        acted = False
+        for role in sorted(groups):
+            members = groups[role]
+            try:
+                depth = sum(rep.engine.load()["queue_depth"]
+                            for _, rep in members) / len(members)
+            except ReplicaUnavailableError:
+                continue    # a child died mid-read; step() contains it
+            if acted:
+                continue    # one action per tick; later roles' streaks
+                # simply hold (neither advanced nor disarmed)
+            # shrink bounds: the fleet-wide floor, plus never the last
+            # replica of a specialist role (a roleless fleet's single
+            # "mixed" group is bounded by the floor alone)
+            can_shrink = (len(alive)
+                          > self.config.autoscale_min_replicas
+                          and (not self._roles_enabled
+                               or len(members) > 1))
+            hi_s = self._as_hi_streaks.get(role, 0)
+            lo_s = self._as_lo_streaks.get(role, 0)
+            hi_s = (hi_s + 1 if (hi is not None and depth > hi
+                                 and can_grow) else 0)
+            lo_s = (lo_s + 1 if (lo is not None and depth < lo
+                                 and can_shrink) else 0)
+            if hi_s >= self.config.autoscale_patience:
+                hi_s = lo_s = 0
+                self._scale_up(role)
+                acted = True    # at most one action per tick
+            elif lo_s >= self.config.autoscale_patience:
+                hi_s = lo_s = 0
+                self._scale_down(role)
+                acted = True
+            self._as_hi_streaks[role] = hi_s
+            self._as_lo_streaks[role] = lo_s
+        # the pre-role scalar views (tests and dashboards read them;
+        # exact for colocated fleets, the max across roles otherwise)
+        self._autoscale_hi_streak = max(self._as_hi_streaks.values(),
+                                        default=0)
+        self._autoscale_lo_streak = max(self._as_lo_streaks.values(),
+                                        default=0)
 
-    def _scale_up(self) -> None:
+    def _scale_up(self, role: str = "mixed") -> None:
         """Append one fresh replica slot (same spawn path respawn
-        uses) and warm its prefix cache from the survivors — an
-        autoscaled newcomer should serve affinity traffic, not start
-        from a cold index."""
+        uses) of the breaching role and warm its prefix cache from
+        the survivors — an autoscaled newcomer should serve affinity
+        traffic, not start from a cold index."""
         idx = len(self.replicas)
         self._drafters.append(None)
         self._faults.append(None)
+        self._roles.append(role)
         self.replicas.append(self._spawn(idx))
         self._num_spawned += 1
         if self._obs is not None:
             self._obs.record("replica_spawn", replica=idx,
-                             reason="autoscale")
+                             reason="autoscale", role=role)
         try:
             self._warm_replica(idx)
         except Exception:
@@ -1142,12 +1276,16 @@ class FleetRouter:
             if payloads:
                 target.import_prefix_payloads(payloads)
 
-    def _scale_down(self) -> None:
-        """Retire one replica through the clean drain-and-migrate
-        path. The victim is deterministic: fewest owned live requests
-        (cheapest drain), ties to the HIGHEST index (autoscaled slots
-        retire before the original fleet)."""
-        alive = self._alive()
+    def _scale_down(self, role: str = "mixed") -> None:
+        """Retire one replica of the under-loaded role through the
+        clean drain-and-migrate path. The victim is deterministic:
+        fewest owned live requests (cheapest drain), ties to the
+        HIGHEST index (autoscaled slots retire before the original
+        fleet)."""
+        alive = [(i, rep) for i, rep in self._alive()
+                 if rep.role == role]
+        if not alive:
+            return
         owned: Dict[int, int] = {i: 0 for i, _ in alive}
         for o in self._owner.values():
             if o in owned:
@@ -1161,7 +1299,54 @@ class FleetRouter:
         self._num_retired += 1
         if self._obs is not None:
             self._obs.record("replica_retire", replica=victim,
-                             reason="autoscale")
+                             reason="autoscale", role=role)
+
+    # -- disaggregated handoff (docs/fleet.md, "Disaggregated roles") ------
+
+    def _handoff_tick(self) -> None:
+        """The per-tick prefill->decode handoff sweep: every started
+        request (prefill complete, first token known) on a
+        prefill-specialist replica migrates to a decode specialist
+        through the checksummed drain-and-migrate transport — records
+        carry the emitted tokens and arrival identity (resume is
+        bit-identical, the PR 12 cert), KV payloads ride the spill
+        tier so the decode side re-admits as a prefix hit instead of
+        recomputing, and a refused (corrupt) import leaves the request
+        on its source exactly like any migration refusal. A no-op for
+        colocated fleets."""
+        if not self._roles_enabled:
+            return
+        for i, rep in self._alive():
+            if rep.role != "prefill" or not rep.alive \
+                    or rep.engine is None:
+                continue
+            try:
+                uids = [u for u in rep.engine.decoding_uids()
+                        if u not in self._sdc_pending]
+            except ReplicaUnavailableError as e:
+                self._fail_replica(i, f"{type(e).__name__}: {e}")
+                continue
+            if uids:
+                try:
+                    self.migrate(uids, i, _handoff=True)
+                except ReplicaUnavailableError as e:
+                    self._fail_replica(i, f"{type(e).__name__}: {e}")
+
+    @staticmethod
+    def _payload_nbytes(payloads: Mapping[str, Dict]) -> int:
+        """Approximate wire size of a handoff's KV payloads — array
+        leaves by their buffer size, strings/bytes by length (the
+        ``num_handoff_bytes`` gauge; observability, not billing)."""
+        n = 0
+        for payload in payloads.values():
+            for v in payload.values():
+                if hasattr(v, "nbytes"):
+                    n += int(v.nbytes)
+                elif isinstance(v, (bytes, bytearray, str)):
+                    n += len(v)
+                elif isinstance(v, (list, tuple)):
+                    n += 8 * len(v)
+        return n
 
     # -- health, failover, migration ---------------------------------------
 
@@ -1187,7 +1372,8 @@ class FleetRouter:
         rep.error = reason
         self._num_replicas_down += 1
         if self._obs is not None:
-            self._obs.record("replica_down", replica=idx, reason=reason)
+            self._obs.record("replica_down", replica=idx,
+                             reason=reason, role=rep.role)
         snap = None
         if rep.engine is not None and trust_state:
             snap = rep.engine.last_checkpoint
@@ -1342,7 +1528,13 @@ class FleetRouter:
         verdict is not a loss)."""
         uid = rec["uid"]
         seq = list(rec["prompt"]) + list(rec.get("generated", ()))[:-1]
-        idx = self._ranked(seq)[0][0]
+        # role-aware failover: a record with generated history is
+        # mid-decode and re-homes onto the decode specialists; a
+        # waiting entry (or a fresh re-injection) still needs prefill.
+        # _ranked degrades to any survivor when the role group is
+        # empty — zero-lost outranks specialization.
+        stage = "decode" if rec.get("generated") else "prefill"
+        idx = self._ranked(seq, stage)[0][0]
         try:
             self.replicas[idx].engine.import_requests([seal_record(rec)])
         except IntegrityError as e:
@@ -1400,7 +1592,8 @@ class FleetRouter:
         self._fail_replica(idx, "killed", read_host_state=False)
 
     def migrate(self, uids: Optional[Sequence[str]], src: int,
-                dst: Optional[int] = None) -> int:
+                dst: Optional[int] = None, *,
+                _handoff: bool = False) -> int:
         """Drain-and-migrate: move the given live requests (all of the
         source's, when ``uids`` is None) off replica ``src`` — onto
         ``dst``, or onto whatever the placement score picks per
@@ -1423,6 +1616,7 @@ class FleetRouter:
                     "replica")
         records = rep.engine.export_requests(uids)
         moved = 0
+        nbytes = 0
         for rec in records:
             uid = rec["uid"]
             seq = (list(rec["prompt"])
@@ -1431,10 +1625,17 @@ class FleetRouter:
             if self.config.migrate_spill_payloads:
                 payloads = rep.engine.export_prefix_payloads(
                     self._seq_hashes(seq))
+                if payloads:
+                    nbytes += self._payload_nbytes(payloads)
             if dst is not None:
                 idx = dst
             else:
-                ranked = [i for i, _ in self._ranked(seq) if i != src]
+                # two-stage under roles: a record with generated
+                # history is mid-decode (rank the decode specialists),
+                # a plain waiting entry still needs its prefill
+                stage = "decode" if rec.get("generated") else "prefill"
+                ranked = [i for i, _ in self._ranked(seq, stage)
+                          if i != src]
                 idx = ranked[0] if ranked else src
             target = self.replicas[idx].engine
             if payloads:
@@ -1473,7 +1674,32 @@ class FleetRouter:
                 self._obs.record("migrate", src=src,
                                  dst=(dst if dst is not None else -1),
                                  requests=moved)
+            if _handoff:
+                self._num_handoffs += 1
+                self._num_handoff_requests += moved
+                self._num_handoff_bytes += nbytes
+                if self._obs is not None:
+                    self._obs.record(
+                        "prefill_handoff", src=src, requests=moved,
+                        bytes=nbytes,
+                        prefill_queue=self._role_backlog("prefill"),
+                        decode_queue=self._role_backlog("decode"))
         return moved
+
+    def _role_backlog(self, role: str) -> int:
+        """Summed backlog (waiting + active lanes) over the alive
+        replicas of one role — the handoff event's per-role queue
+        snapshot and the trace summary's disaggregation line."""
+        total = 0
+        for i, rep in self._alive():
+            if rep.role != role:
+                continue
+            try:
+                ld = rep.engine.load()
+            except ReplicaUnavailableError:
+                continue
+            total += int(ld["queue_depth"] + ld["active_slots"])
+        return total
 
     def _requeue_refused(self, rec: Dict, src: int) -> None:
         """A migration import was refused on a checksum mismatch: the
@@ -1495,6 +1721,20 @@ class FleetRouter:
         fresh = _request_record(req)
         fresh["generated"] = [int(t) for t in
                               self._delivered.get(uid, ())]
+        # the source's undrained stream events for this uid cover
+        # exactly the tokens past the delivered watermark — the
+        # recompute below re-derives (and re-emits) them
+        # bit-identically, so the stale copies must go first or each
+        # token would be delivered twice, shifting every later
+        # position in the ledger
+        rep.engine.drop_stream_events(uid)
+        # the recompute must re-draw the SAME sampled tokens past the
+        # delivered watermark: sampling is arrival-keyed, and the
+        # rotted record's own arrival field is exactly what cannot be
+        # trusted — the source engine kept a clean copy at export
+        arrival = rep.engine.exported_arrival(uid)
+        if arrival is not None:
+            fresh["arrival"] = arrival
         try:
             rep.engine.import_requests([seal_record(fresh)])
         except IntegrityError as e:
@@ -1548,7 +1788,8 @@ class FleetRouter:
                 rep.engine = None
             if self._obs is not None:
                 self._obs.record("replica_down", replica=src,
-                                 reason="retired")
+                                 reason="retired",
+                                 role=self.replicas[src].role)
         return moved
 
     def close(self) -> None:
@@ -1580,6 +1821,7 @@ class FleetRouter:
             row: Dict[str, object] = {
                 "alive": bool(rep.alive and rep.engine is not None),
                 "mode": rep.mode,
+                "role": rep.role,
                 "routed": rep.routed,
                 "stall_streak": rep.stall_streak,
                 "error": rep.error,
@@ -1625,6 +1867,16 @@ class FleetRouter:
             "num_retired": self._num_retired,
             "num_rpc_retries": self._num_rpc_retries,
             "num_rpc_timeouts": self._num_rpc_timeouts,
+            # disaggregated prefill/decode roles (docs/fleet.md,
+            # "Disaggregated roles"): handoff sweeps, requests moved
+            # and payload bytes shipped prefill->decode, and the
+            # affinity probes the two-stage router short-circuited
+            # (always 0 colocated)
+            "num_handoffs": self._num_handoffs,
+            "num_handoff_requests": self._num_handoff_requests,
+            "num_handoff_bytes": self._num_handoff_bytes,
+            "num_affinity_probes_skipped":
+                self._num_affinity_probes_skipped,
             "num_lost_requests": (self._num_accepted - len(self._owner)
                                   - self._num_terminal),
             "queue_depth": sum(rep.engine.queue_depth

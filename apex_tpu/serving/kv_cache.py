@@ -209,7 +209,8 @@ class KVCache(NamedTuple):
     def head_dim(self) -> int:
         return self.k.shape[4]
 
-    def partition_specs(self, model_axis: str = "model") -> "KVCache":
+    def partition_specs(self, model_axis: str = "model",
+                        batch_axis: Optional[str] = None) -> "KVCache":
         """The pool's mesh layout (docs/serving.md, "Mesh sharding"):
         a :class:`~jax.sharding.PartitionSpec` per pool, sharding the
         HEAD axis over ``model_axis`` — heads are the one axis the
@@ -217,12 +218,17 @@ class KVCache(NamedTuple):
         address layer/block/slot), so a head split needs zero
         collectives for pool maintenance, and the per-row scale pools
         split on the same axis so a block's scales stay colocated with
-        its bytes. Returned as a KVCache-of-specs so callers
-        ``tree.map`` it against the pool (``None`` scale fields line
-        up with ``None`` specs)."""
-        payload = PartitionSpec(None, None, None, model_axis, None)
+        its bytes. With ``batch_axis`` set (the data-parallel lane
+        split), the BLOCK axis shards over it too: the allocator keeps
+        a lane's blocks inside its shard's contiguous id range, so the
+        sharded programs index only shard-local blocks and the split
+        stays collective-free (docs/serving.md, "The batch axis").
+        Returned as a KVCache-of-specs so callers ``tree.map`` it
+        against the pool (``None`` scale fields line up with ``None``
+        specs)."""
+        payload = PartitionSpec(None, batch_axis, None, model_axis, None)
         scale = (None if self.k_scale is None
-                 else PartitionSpec(None, None, None, model_axis))
+                 else PartitionSpec(None, batch_axis, None, model_axis))
         return KVCache(k=payload, v=payload, k_scale=scale, v_scale=scale)
 
     @classmethod
@@ -286,8 +292,27 @@ class BlockAllocator:
       ``match_prefix`` revives them.
     """
 
-    def __init__(self, num_blocks: int, block_weight: float = 1.0):
+    def __init__(self, num_blocks: int, block_weight: float = 1.0,
+                 num_shards: int = 1):
         self.num_blocks = int(num_blocks)
+        # the data-parallel block-shard count (the mesh's ``batch``
+        # axis size): shard ``s`` owns the contiguous id range
+        # ``[s * blocks_per_shard, (s + 1) * blocks_per_shard)``, and
+        # shard-scoped alloc/evict/match keep every sequence's blocks
+        # inside its lane's shard — the host-side invariant that makes
+        # the device-side batch split collective-free. ``num_shards=1``
+        # (the default and every pre-batch-axis engine) makes every
+        # shard argument a no-op: behavior is bit-identical.
+        self.num_shards = int(num_shards)
+        if self.num_shards < 1:
+            raise ValueError(
+                f"num_shards must be >= 1, got {num_shards}")
+        if self.num_blocks % self.num_shards:
+            raise ValueError(
+                f"num_shards ({self.num_shards}) must divide num_blocks "
+                f"({self.num_blocks}): the pool splits into equal "
+                "contiguous shard ranges")
+        self.blocks_per_shard = self.num_blocks // self.num_shards
         # the per-block charge unit of the tenant ledger: quantized
         # pools pass their reduced byte footprint relative to the
         # full-precision block (e.g. ~0.28 for int8-vs-fp32), so a
@@ -351,6 +376,22 @@ class BlockAllocator:
     def num_used(self) -> int:
         """Blocks currently referenced by live sequences."""
         return self.num_blocks - len(self._free) - len(self._evictable)
+
+    def shard_of(self, block_id: int) -> int:
+        """The data-parallel shard owning a block id (shard ranges are
+        contiguous: ``id // blocks_per_shard``). Always 0 unsharded."""
+        return int(block_id) // self.blocks_per_shard
+
+    def free_in_shard(self, shard: int) -> int:
+        """Free blocks inside one shard's id range."""
+        return sum(1 for b in self._free
+                   if b // self.blocks_per_shard == shard)
+
+    def cached_in_shard(self, shard: int) -> int:
+        """Evictable (refcount-0, prefix-indexed) blocks inside one
+        shard's id range."""
+        return sum(1 for b in self._evictable
+                   if b // self.blocks_per_shard == shard)
 
     @property
     def utilization(self) -> float:
@@ -427,15 +468,25 @@ class BlockAllocator:
 
     # -- alloc / free / share ----------------------------------------------
 
-    def _evict_one(self, flushed: bool = False) -> int:
+    def _evict_one(self, flushed: bool = False,
+                   shard: Optional[int] = None) -> int:
         """Drop the least-recently-used cached block (unregister it),
         charging the eviction to the tenant that registered the block
         (``flushed`` routes the charge to the flush counter — the
         degradation ladder's rung-2 accounting). With a spill tier
         attached, the block's contents are copied to the host store
         first — the eviction stops being a future recompute and
-        becomes a future upload."""
-        b, _ = self._evictable.popitem(last=False)
+        becomes a future upload. ``shard`` restricts the LRU walk to
+        one shard's id range (the batch-axis pools evict only where
+        the allocation must land); raises ``KeyError`` when that shard
+        holds no cached block — callers gate on
+        :meth:`cached_in_shard`."""
+        if shard is None:
+            b, _ = self._evictable.popitem(last=False)
+        else:
+            b = next(x for x in self._evictable
+                     if x // self.blocks_per_shard == shard)
+            del self._evictable[b]
         h = self._block_to_hash.pop(b)
         del self._hash_to_block[h]
         owner = self._cached_owner.pop(b, None)
@@ -451,17 +502,53 @@ class BlockAllocator:
         self.num_evictions += 1
         return b
 
-    def alloc(self, n: int, tenant: str = DEFAULT_TENANT) -> List[int]:
+    def alloc(self, n: int, tenant: str = DEFAULT_TENANT,
+              shard: Optional[int] = None) -> List[int]:
         """Hand out ``n`` blocks at refcount 1 (charged to ``tenant``),
         evicting LRU cached blocks when the free list alone cannot
-        serve the request."""
-        if n > len(self._free) + len(self._evictable):
+        serve the request. ``shard`` restricts the allocation to one
+        shard's contiguous id range (the batch-axis engine allocates a
+        lane's blocks only on the lane's shard); a shard-scoped
+        request that cannot be served from THAT shard raises
+        ``CacheOutOfBlocks`` even when other shards hold free blocks —
+        cross-shard placement would break the collective-free device
+        split. ``shard=None`` (and every single-shard allocator) is
+        the pre-batch-axis path, bit for bit."""
+        if shard is None or self.num_shards == 1:
+            if n > len(self._free) + len(self._evictable):
+                raise CacheOutOfBlocks(
+                    f"requested {n} blocks, {len(self._free)} free + "
+                    f"{len(self._evictable)} evictable of "
+                    f"{self.num_blocks}")
+            out = []
+            for _ in range(n):
+                b = self._free.pop() if self._free else self._evict_one()
+                self._ref[b] = 1
+                self._tenant_refs[b] = {tenant: 1}
+                self._charge_block(b, +1)
+                out.append(b)
+            return out
+        shard = int(shard)
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(
+                f"shard {shard} out of range [0, {self.num_shards})")
+        free_s = self.free_in_shard(shard)
+        if n > free_s + self.cached_in_shard(shard):
             raise CacheOutOfBlocks(
-                f"requested {n} blocks, {len(self._free)} free + "
-                f"{len(self._evictable)} evictable of {self.num_blocks}")
+                f"requested {n} blocks on shard {shard}, {free_s} free "
+                f"+ {self.cached_in_shard(shard)} evictable of "
+                f"{self.blocks_per_shard} shard blocks")
         out = []
         for _ in range(n):
-            b = self._free.pop() if self._free else self._evict_one()
+            b = None
+            # same LIFO discipline as the unsharded pop(): the most
+            # recently freed block of the shard serves first
+            for i in range(len(self._free) - 1, -1, -1):
+                if self._free[i] // self.blocks_per_shard == shard:
+                    b = self._free.pop(i)
+                    break
+            if b is None:
+                b = self._evict_one(shard=shard)
             self._ref[b] = 1
             self._tenant_refs[b] = {tenant: 1}
             self._charge_block(b, +1)
@@ -559,25 +646,35 @@ class BlockAllocator:
         probe and the migration transport's device-vs-spill split."""
         return self._hash_to_block.get(block_hash)
 
-    def lookup_prefix(self, hashes: Sequence[str]) -> List[int]:
+    def lookup_prefix(self, hashes: Sequence[str],
+                      shard: Optional[int] = None) -> List[int]:
         """Longest indexed prefix of the hash chain, WITHOUT taking
         references — for capacity checks before committing to an
-        admission (no rollback, no LRU perturbation)."""
+        admission (no rollback, no LRU perturbation). ``shard`` stops
+        the walk at the first block OUTSIDE that shard's id range: a
+        batch-axis lane can only share blocks resident on its own
+        shard (a cross-shard match would put a foreign block id in a
+        table the sharded program cannot reach)."""
         out: List[int] = []
         for h in hashes:
             b = self._hash_to_block.get(h)
             if b is None:
                 break
+            if (shard is not None
+                    and b // self.blocks_per_shard != shard):
+                break
             out.append(b)
         return out
 
     def match_prefix(self, hashes: Sequence[str],
-                     tenant: str = DEFAULT_TENANT) -> List[int]:
+                     tenant: str = DEFAULT_TENANT,
+                     shard: Optional[int] = None) -> List[int]:
         """Longest indexed prefix of the hash chain: returns the block
         ids (in sequence order) and acquires a reference on each for
         ``tenant`` — callers own the returned blocks and must ``free``
-        them under the same tenant."""
-        out = self.lookup_prefix(hashes)
+        them under the same tenant. ``shard`` applies the
+        :meth:`lookup_prefix` shard restriction."""
+        out = self.lookup_prefix(hashes, shard=shard)
         self.acquire(out, tenant=tenant)
         return out
 

@@ -31,9 +31,20 @@ What shards where (the full table lives in docs/serving.md):
   replication is what keeps the sampler and the drain byte-identical
   across mesh shapes.
 
-The ``batch`` axis is declared (the pod story: data-parallel replicas
-of the same program) but nothing currently shards over it — a
-``(B, 1)`` mesh is collective-free like ``(1, 1)``.
+The ``batch`` axis is the DATA-PARALLEL lane split (docs/serving.md,
+"The batch axis"): at ``mesh_shape=(B, M)`` with ``B > 1`` the engine
+splits its ``max_batch`` decode lanes and the KV pools' BLOCK axis
+into ``B`` contiguous shards, one per ``batch`` coordinate — so one
+engine holds ``B`` times the concurrent residents of a ``(1, M)``
+mesh at the same per-device pool footprint. The allocator pins every
+sequence's blocks to its lane's shard, the sharded programs localize
+the (global-id) block tables by subtracting the shard's base id
+(foreign entries go out of bounds, where the scatter drops and the
+gather reads masked garbage), and the per-lane sampler is already
+schedule-invariant — which is why the split needs NO new collectives:
+a ``(B, 1)`` mesh lowers collective-free like ``(1, 1)``, and a
+``(B, M)`` mesh shows exactly the ``(1, M)`` model-axis reduction
+traffic (:func:`expected_collectives` is per-shape).
 
 **Identity contract**: mesh ``(1, 1)`` — the default — reproduces the
 pre-mesh engine bit for bit (outputs, statuses, the full ``stats()``
@@ -58,7 +69,10 @@ MESH_AXES = ("batch", "model")
 
 
 def validate_mesh_shape(mesh_shape, num_heads: Optional[int] = None,
-                        knob: str = "mesh_shape") -> Tuple[int, int]:
+                        knob: str = "mesh_shape",
+                        max_batch: Optional[int] = None,
+                        num_blocks: Optional[int] = None
+                        ) -> Tuple[int, int]:
     """Validate (and normalize to a tuple) a ``(batch, model)`` mesh
     shape: two positive ints, a device footprint the backend can
     actually supply (checked lazily — the trivial ``(1, 1)`` never
@@ -66,7 +80,11 @@ def validate_mesh_shape(mesh_shape, num_heads: Optional[int] = None,
     trigger plugin init), and — when the caller knows the model — a
     ``model``-axis size dividing ``num_heads`` (the KV pools and the
     qkv projections shard over heads; a non-dividing split has no
-    layout). Named-knob errors, matching the config validation style."""
+    layout). When the caller knows the engine geometry, the ``batch``
+    axis must divide ``max_batch`` (lanes split into equal per-shard
+    groups) and ``num_blocks`` (the pool splits into equal contiguous
+    shard ranges). Named-knob errors, matching the config validation
+    style."""
     try:
         shape = tuple(int(v) for v in mesh_shape)
         if any(s != v for s, v in zip(shape, mesh_shape)):
@@ -93,6 +111,16 @@ def validate_mesh_shape(mesh_shape, num_heads: Optional[int] = None,
             f"{knob} model axis ({shape[1]}) must divide the model's "
             f"num_heads ({num_heads}): the KV pools and qkv projections "
             "shard over heads")
+    if max_batch is not None and max_batch % shape[0]:
+        raise ValueError(
+            f"{knob} batch axis ({shape[0]}) must divide max_batch "
+            f"({max_batch}): decode lanes split into equal per-shard "
+            "groups")
+    if num_blocks is not None and num_blocks % shape[0]:
+        raise ValueError(
+            f"{knob} batch axis ({shape[0]}) must divide num_blocks "
+            f"({num_blocks}): the KV pool splits into equal contiguous "
+            "shard ranges")
     return shape
 
 
@@ -115,15 +143,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def cache_shardings(mesh: Mesh, cache):
     """``NamedSharding`` pytree for a :class:`~apex_tpu.serving.
-    kv_cache.KVCache`: the pool's head axis over ``model``
+    kv_cache.KVCache`: the pool's head axis over ``model``, and — once
+    the ``batch`` axis is wider than 1 — the block axis over ``batch``
     (:meth:`KVCache.partition_specs` owns the spec layout; this binds
-    it to a concrete mesh). Also the ``out_shardings`` every jitted
-    program pins its returned cache to — without the pin, GSPMD may
-    hand back a differently-laid-out pool and the next dispatch's
-    changed input sharding would recompile, breaking the one-program
-    compile-count contract."""
+    it to a concrete mesh; a 1-wide batch axis keeps the exact
+    pre-batch-axis spec, preserving the ``(1, 1)`` bit-identity
+    certification). Also the ``out_shardings`` every jitted program
+    pins its returned cache to — without the pin, GSPMD may hand back
+    a differently-laid-out pool and the next dispatch's changed input
+    sharding would recompile, breaking the one-program compile-count
+    contract."""
+    batch_axis = "batch" if mesh.shape["batch"] > 1 else None
     return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
-                        cache.partition_specs())
+                        cache.partition_specs(batch_axis=batch_axis))
 
 
 def shard_cache(mesh: Mesh, cache):
@@ -149,23 +181,41 @@ def shard_params(mesh: Mesh, params, pspec_fn=None):
 def program_out_shardings(mesh: Mesh, cache):
     """The ``(cache, tokens)`` output-sharding pair of the engine's
     prefill/decode/verify programs: the pool pinned to its mesh
-    layout, emitted tokens replicated (the host drains them). Returned
-    as a 2-tuple the engine threads into ``jax.jit(out_shardings=...)``
+    layout, emitted tokens replicated (the host drains them). With a
+    sharded batch axis the tokens pin to ``P("batch")`` instead —
+    each shard computed only its own lanes' tokens, and replicating
+    them would force the partitioner to insert an all-gather into the
+    decode program (breaking the batch axis's no-new-collectives
+    contract); the host's fetch assembles the shards. Returned as a
+    2-tuple the engine threads into ``jax.jit(out_shardings=...)``
     (cache-only programs — CoW copy, spill upload — use element 0)."""
-    return cache_shardings(mesh, cache), replicated(mesh)
+    if mesh.shape["batch"] > 1:
+        tokens = NamedSharding(mesh, PartitionSpec("batch"))
+    else:
+        tokens = replicated(mesh)
+    return cache_shardings(mesh, cache), tokens
 
 
 def expected_collectives(mesh_shape) -> dict:
     """The sharded program-shape contract for
-    :func:`apex_tpu.utils.hlo_audit.assert_collective_contract`: with a
-    1-sized ``model`` axis every program must lower collective-free
-    (nothing to synchronize — the bit-identity certification leans on
-    this); once heads split, the Megatron-via-GSPMD layout must show
-    cross-partition reduction traffic (all-reduce, or the
-    reduce-scatter + all-gather pair XLA sometimes splits one into)
-    and must NOT show all-to-all (a resharding of the sequence or head
-    axis this layout never asks for — its appearance means the
-    partitioner lost the intended layout somewhere)."""
+    :func:`apex_tpu.utils.hlo_audit.assert_collective_contract`, per
+    shape over BOTH axes. The ``batch`` axis contributes NOTHING at
+    any shape — shards hold disjoint lanes and disjoint pool ranges,
+    tables localize by subtraction, and token outputs stay
+    batch-sharded, so there is no cross-shard data motion to lower:
+
+    - ``model == 1`` (including every ``(B, 1)`` batch split): every
+      program must lower with ZERO collectives — the bit-identity
+      certification at ``(1, 1)`` and the batch axis's
+      no-new-collectives contract at ``(B, 1)`` both lean on this.
+    - ``model > 1`` (``(1, M)`` and the combined ``(B, M)``): the
+      Megatron-via-GSPMD layout must show cross-partition reduction
+      traffic (all-reduce, or the reduce-scatter + all-gather pair XLA
+      sometimes splits one into) and must NOT show all-to-all (a
+      resharding of the sequence or head axis this layout never asks
+      for — its appearance means the partitioner lost the intended
+      layout somewhere, and at ``B > 1`` it is exactly what a leaked
+      cross-shard lane or pool index would look like)."""
     shape = validate_mesh_shape(mesh_shape)
     if shape[1] == 1:
         return {"exact_total_ops": 0}

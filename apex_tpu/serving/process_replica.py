@@ -426,6 +426,16 @@ class ProcessReplica:
     def probe_prefix(self, hashes: Sequence[str]) -> int:
         return int(self._call("probe_prefix", list(hashes)))
 
+    def decoding_uids(self) -> List[str]:
+        return [str(u) for u in self._call("decoding_uids")]
+
+    def exported_arrival(self, uid: str) -> Optional[int]:
+        v = self._call("exported_arrival", str(uid))
+        return None if v is None else int(v)
+
+    def drop_stream_events(self, uid: str) -> int:
+        return int(self._call("drop_stream_events", str(uid)))
+
     def export_requests(self, uids: Optional[Sequence[str]] = None
                         ) -> List[Dict]:
         records = self._call(

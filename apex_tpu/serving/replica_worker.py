@@ -88,6 +88,12 @@ class _Servicer:
             return eng.load(), {}
         if method == "probe_prefix":
             return int(eng.probe_prefix(list(args[0]))), {}
+        if method == "decoding_uids":
+            return [str(u) for u in eng.decoding_uids()], {}
+        if method == "exported_arrival":
+            return eng.exported_arrival(str(args[0])), {}
+        if method == "drop_stream_events":
+            return int(eng.drop_stream_events(str(args[0]))), {}
         if method == "export_requests":
             uids = args[0] if args else None
             return eng.export_requests(uids), {}

@@ -3062,6 +3062,247 @@ def bench_serving_process(fast=False):
     }
 
 
+def bench_serving_disagg(fast=False):
+    """Disaggregated prefill/decode arm (round 17, docs/fleet.md
+    "Disaggregated roles"): specialist replicas vs the colocated fleet
+    at EQUAL device count, on a trace built to expose the interference
+    disaggregation removes — long-decode requests pin a colocated
+    replica's lanes for their whole decode, so a newcomer's prefill
+    waits out someone else's generation, and every prefill chunk that
+    does run lands its latency on the resident decodes sharing the
+    tick.
+
+    Three phases: (1) colocated baseline — 2 role-less replicas serve
+    a seeded Poisson mix of long-decode and latency-sensitive
+    short-prompt requests; TTFT p99 (scheduler ticks), decode goodput
+    (wall), and the interference quantified directly: ticks where a
+    replica ran a prefill chunk AND stepped live decode lanes
+    (chunk-over-decode), plus lane-wait implied by the TTFT tail; (2)
+    the SAME trace on a {1 prefill + 1 decode} specialist fleet —
+    prefill lanes recycle at handoff instead of being held through
+    decode, so the arm asserts the disaggregated TTFT p99 is LOWER
+    than colocated, decode specialists never prefilled a fresh
+    prompt (their chunk count is bounded by their handoff imports —
+    only sub-block tail resumes), the handoff counters moved real
+    requests/bytes, and nothing was lost;
+    (3) chaos — the prefill specialist is hard-killed mid-trace:
+    role fallback + checkpoint failover must finish every accepted
+    request with ``num_lost_requests == 0``. ``vs_baseline`` is
+    disaggregated p99 / colocated p99 (< 1 = disaggregation pays).
+    ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import percentile
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  Request, SamplingParams)
+
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    # lanes are the contended resource: few of them, long decodes
+    ekw = dict(max_batch=2, block_size=8, num_blocks=96,
+               max_prefill_len=8, max_seq_len=64,
+               enable_prefix_caching=True, spill_max_bytes=1 << 20,
+               snapshot_interval_ticks=2, max_waiting=64, seed=11)
+    ticks = 14 if fast else 28
+    rate = 1.0 if fast else 0.9
+    heavy_new = 16 if fast else 24
+    kill_tick = 5 if fast else 9
+    model = GPTLMHeadModel(cfg)
+    # FIXED seeds (not _SALT): the arm asserts a latency ORDERING
+    # between two fleets on one trace — the trace must be the same
+    # every round or the assert flakes
+    init_rng = np.random.RandomState(1712)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(init_rng.randint(0, cfg.vocab_size, (1, 8))))
+
+    def make_trace():
+        rng = np.random.RandomState(1713)
+
+        def make(tick, k):
+            heavy = (k % 3) != 2
+            # single-chunk prompts: the contended resource is the
+            # LANE a long decode pins, not prefill chunk bandwidth
+            plen = int(rng.randint(6, 9) if heavy
+                       else rng.randint(4, 7))
+            prompt = list(rng.randint(0, cfg.vocab_size, plen))
+            new = (heavy_new + int(rng.randint(0, 4)) if heavy
+                   else int(rng.randint(2, 5)))
+            samp = (SamplingParams() if k % 2 else
+                    SamplingParams(temperature=1.0, top_k=40))
+            return lambda: Request(uid=f"q{k}", prompt=list(prompt),
+                                   max_new_tokens=new, sampling=samp)
+
+        return _poisson_burst_trace(
+            rng, ticks=ticks, base_rate=rate, make_request=make,
+            burst_start=ticks // 3, burst_end=2 * ticks // 3,
+            burst_factor=2)
+
+    def drive(router, trace, kill_at=None, kill_idx=None):
+        """Tick through the trace; per-uid submit/first-token ticks
+        via the stream feed. Interference probe per tick: a replica
+        that both chunked a prefill and stepped decode lanes charged
+        that chunk's latency to the residents (chunk-over-decode).
+        Returns (ttft, accepted, contended_ticks, chunks_by_rep,
+        wall_s)."""
+        submit, first, accepted = {}, {}, []
+        contended = 0
+        t0 = time.perf_counter()
+        i = tick = 0
+
+        def counters():
+            out = {}
+            for idx, rep in enumerate(router.replicas):
+                if rep.alive and rep.engine is not None:
+                    s = rep.engine.stats()
+                    out[idx] = (int(s["num_prefill_chunks"]),
+                                int(s["num_decode_steps"]))
+            return out
+
+        before = counters()
+        while i < len(trace) or router.has_work:
+            while i < len(trace) and trace[i][0] <= tick:
+                req = trace[i][1]()
+                if router.try_add(req):
+                    submit[req.uid] = tick
+                    accepted.append(req.uid)
+                i += 1
+            if (kill_at is not None and tick == kill_at
+                    and router.replicas[kill_idx].alive):
+                router.kill_replica(kill_idx)
+            router.step()
+            after = counters()
+            for idx in after:
+                b = before.get(idx, (0, 0))
+                if (after[idx][0] > b[0] and after[idx][1] > b[1]):
+                    contended += 1
+            before = after
+            for uid, tok, last in router.pop_stream_events():
+                if tok >= 0 and uid not in first and uid in submit:
+                    first[uid] = tick
+            tick += 1
+        wall = time.perf_counter() - t0
+        chunks = {idx: c for idx, (c, _) in before.items()}
+        ttft = {u: first[u] - submit[u] for u in first}
+        return ttft, accepted, contended, chunks, wall
+
+    def pct(xs, q):
+        return percentile(xs, q) if xs else 0.0
+
+    def goodput(res, wall):
+        return sum(len(r.tokens) for r in res.values()
+                   if r.status == "finished") / max(wall, 1e-9)
+
+    # -- phase 1: the colocated baseline (2 role-less replicas) --
+    trace = make_trace()
+    colo = FleetRouter(model, params, EngineConfig(**ekw),
+                       FleetConfig(num_replicas=2))
+    ttft_colo, acc_colo, contended_colo, _, wall_colo = drive(
+        colo, trace)
+    colo_res = colo.run(return_status=True)
+    colo_stats = colo.stats()
+    assert not (set(acc_colo) - set(colo_res)), "colocated lost requests"
+    assert colo_stats["num_lost_requests"] == 0
+    p99_colo = pct(list(ttft_colo.values()), 99)
+    good_colo = goodput(colo_res, wall_colo)
+
+    # -- phase 2: the same trace, disaggregated at equal device
+    # count ({1 prefill + 1 decode} vs the 2 colocated) --
+    disagg = FleetRouter(model, params, EngineConfig(**ekw),
+                         FleetConfig(num_replicas=2,
+                                     replica_roles=("prefill",
+                                                    "decode")))
+    ttft_dis, acc_dis, contended_dis, chunks_dis, wall_dis = drive(
+        disagg, trace)
+    dis_res = disagg.run(return_status=True)
+    dis_stats = disagg.stats()
+    assert not (set(acc_dis) - set(dis_res)), "disagg lost requests"
+    assert dis_stats["num_lost_requests"] == 0
+    assert dis_stats["num_handoffs"] >= 1, "no handoff sweep fired"
+    assert dis_stats["num_handoff_requests"] >= 1
+    assert dis_stats["num_handoff_bytes"] > 0
+    decode_rows = {idx: dis_stats["replicas"][str(idx)]
+                   for idx in chunks_dis
+                   if dis_stats["replicas"][str(idx)]["role"]
+                   == "decode"}
+    decode_chunks = sum(chunks_dis[idx] for idx in decode_rows)
+    decode_imports = sum(int(r["num_migrated_in"])
+                         for r in decode_rows.values())
+    # a decode specialist never prefills a FRESH prompt: its only
+    # chunks are the sub-block tail resumes of handed-off requests
+    # (the prefix-cache transport moves full blocks; the tail is
+    # shorter than one chunk), so chunks are bounded by imports
+    assert decode_chunks <= decode_imports, (
+        f"decode specialists ran {decode_chunks} prefill chunks for "
+        f"only {decode_imports} handoff imports — fresh prompts "
+        f"leaked onto the decode pool")
+    p99_dis = pct(list(ttft_dis.values()), 99)
+    good_dis = goodput(dis_res, wall_dis)
+    # the headline ordering: specialist prefill lanes recycle at the
+    # handoff instead of being held hostage through a long decode
+    assert p99_dis < p99_colo, (
+        f"disaggregated TTFT p99 {p99_dis} ticks did not beat "
+        f"colocated {p99_colo}")
+
+    # -- phase 3: the prefill specialist hard-killed mid-trace --
+    chaos = FleetRouter(model, params, EngineConfig(**ekw),
+                        FleetConfig(num_replicas=2,
+                                    replica_roles=("prefill",
+                                                   "decode")))
+    _, acc_kill, _, _, _ = drive(chaos, trace, kill_at=kill_tick,
+                                 kill_idx=0)
+    kill_res = chaos.run(return_status=True)
+    kill_stats = chaos.stats()
+    missing = set(acc_kill) - set(kill_res)
+    assert not missing, f"lost accepted requests: {sorted(missing)}"
+    assert kill_stats["num_lost_requests"] == 0
+    assert kill_stats["num_failovers"] >= 1, "the kill never fired"
+    for rep in chaos.replicas:
+        if rep.alive and rep.engine is not None:
+            rep.engine.check_allocator_integrity()
+
+    print(f"# serving disagg: colocated p99 TTFT {p99_colo:.0f} ticks "
+          f"(chunk-over-decode {contended_colo} ticks), goodput "
+          f"{good_colo:.1f} tok/s | disagg p99 {p99_dis:.0f} ticks "
+          f"(contended {contended_dis}), goodput {good_dis:.1f} tok/s "
+          f"| handoffs {dis_stats['num_handoffs']} sweeps / "
+          f"{dis_stats['num_handoff_requests']} req / "
+          f"{dis_stats['num_handoff_bytes']} B, probes skipped "
+          f"{dis_stats['num_affinity_probes_skipped']} | prefill-kill: "
+          f"failovers {kill_stats['num_failovers']}, lost "
+          f"{kill_stats['num_lost_requests']}", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_disagg_ttft_p99_ticks",
+        "value": round(float(p99_dis), 2),
+        "unit": "ticks",
+        # the disaggregation win: specialist TTFT p99 over colocated
+        # TTFT p99 on the interference trace (< 1 = disagg pays)
+        "vs_baseline": round(float(p99_dis) / max(float(p99_colo),
+                                                  1e-9), 4),
+        "colocated_ttft_p99_ticks": round(float(p99_colo), 2),
+        "colocated_goodput_tok_per_sec": round(good_colo, 3),
+        "disagg_goodput_tok_per_sec": round(good_dis, 3),
+        "colocated_chunk_over_decode_ticks": int(contended_colo),
+        "disagg_chunk_over_decode_ticks": int(contended_dis),
+        "decode_specialist_prefill_chunks": int(decode_chunks),
+        "decode_specialist_imports": int(decode_imports),
+        "num_offered": len(trace),
+        "num_accepted_colocated": len(acc_colo),
+        "num_accepted_disagg": len(acc_dis),
+        "num_handoffs": int(dis_stats["num_handoffs"]),
+        "num_handoff_requests": int(dis_stats["num_handoff_requests"]),
+        "num_handoff_bytes": int(dis_stats["num_handoff_bytes"]),
+        "num_affinity_probes_skipped":
+            int(dis_stats["num_affinity_probes_skipped"]),
+        "kill_num_failovers": int(kill_stats["num_failovers"]),
+        "kill_num_lost_requests":
+            int(kill_stats["num_lost_requests"]),
+        "zero_lost": True,
+        "status_counts": {
+            s: sum(r.status == s for r in dis_res.values())
+            for s in {r.status for r in dis_res.values()}},
+        "allocator_integrity_ok": True,
+    }
+
+
 def bench_obs_pipeline(fast=False):
     """Observability pipeline certification (docs/observability.md):
     drive a small engine with the full observer attached (tracer +
@@ -3184,6 +3425,8 @@ def main():
              lambda: bench_serving_mesh(fast=True)),
             ("bench_serving_process",
              lambda: bench_serving_process(fast=True)),
+            ("bench_serving_disagg",
+             lambda: bench_serving_disagg(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -3251,6 +3494,7 @@ def main():
                  bench_serving_multitenant, bench_serving_kv_memory,
                  bench_serving_fleet, bench_serving_integrity,
                  bench_serving_mesh, bench_serving_process,
+                 bench_serving_disagg,
                  bench_train_step, bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)

@@ -528,7 +528,7 @@ def test_bench_smoke_mode_every_section_rc0():
     repo = Path(__file__).resolve().parents[1]
     out = subprocess.run(
         [sys.executable, str(repo / "bench.py"), "--smoke"],
-        capture_output=True, text=True, timeout=560, env=env,
+        capture_output=True, text=True, timeout=700, env=env,
         cwd=str(repo))
     assert out.returncode == 0, out.stderr[-2000:]
     records = [json.loads(line) for line in
@@ -548,6 +548,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_integrity_sdc_detection_latency_ticks",
         "serving_tiny_mesh_decode_tokens_per_sec",
         "serving_tiny_process_kill_goodput_tok_per_sec",
+        "serving_tiny_disagg_ttft_p99_ticks",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -694,6 +695,28 @@ def test_bench_smoke_mode_every_section_rc0():
     assert pr["autoscale_flap_free"] is True, pr
     assert pr["status_counts"].get("finished", 0) > 0, pr
     assert math.isfinite(pr["vs_baseline"]) and pr["value"] > 0, pr
+    # the disaggregation arm (docs/fleet.md "Disaggregated roles")
+    # must prove the two-stage story: the specialist fleet beat the
+    # colocated one on TTFT p99 at equal device count, the handoff
+    # actually moved requests/bytes, decode specialists never
+    # prefilled a fresh prompt, and the prefill-specialist kill lost
+    # nothing — a silently-colocated arm would be a quiet latency lie
+    dg = [r for r in records
+          if r.get("metric") == "serving_tiny_disagg_ttft_p99_ticks"][0]
+    assert dg["vs_baseline"] < 1.0, dg
+    assert dg["value"] < dg["colocated_ttft_p99_ticks"], dg
+    assert dg["num_handoffs"] >= 1, dg
+    assert dg["num_handoff_requests"] >= 1, dg
+    assert dg["num_handoff_bytes"] > 0, dg
+    assert dg["num_affinity_probes_skipped"] >= 1, dg
+    assert (dg["decode_specialist_prefill_chunks"]
+            <= dg["decode_specialist_imports"]), dg
+    assert dg["zero_lost"] is True, dg
+    assert dg["kill_num_failovers"] >= 1, dg
+    assert dg["kill_num_lost_requests"] == 0, dg
+    assert dg["status_counts"].get("finished", 0) > 0, dg
+    assert dg["allocator_integrity_ok"] is True, dg
+    assert math.isfinite(dg["vs_baseline"]) and dg["value"] > 0, dg
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -713,6 +736,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving_multitenant", "bench_serving_kv_memory",
         "bench_serving_fleet", "bench_serving_integrity",
         "bench_serving_mesh", "bench_serving_process",
+        "bench_serving_disagg",
         "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():

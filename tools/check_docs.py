@@ -39,6 +39,7 @@ INTEGRITY_KINDS = ("integrity surface",)
 MESH_KINDS = ("mesh surface",)
 PROCESS_KINDS = ("process surface",)
 AUTOSCALE_KINDS = ("autoscale surface",)
+DISAGG_KINDS = ("disagg surface",)
 MESH_DOCS = ("docs/serving.md",)
 # the pod-scale mesh surface (knob + stats keys) must be named in the
 # "Mesh sharding" doc itself, docs/serving.md — same discipline as the
@@ -47,7 +48,18 @@ MESH_DOCS = ("docs/serving.md",)
 # silently unpinning it.
 MESH_NAMES = (
     "mesh_shape",
-    "mesh_devices", "mesh_model_axis",
+    "mesh_devices", "mesh_model_axis", "mesh_batch_axis",
+)
+# the disaggregated prefill/decode surface (role knob, handoff
+# counters, the two-stage router's probe-skip tally, and the handoff
+# recorder kind) must be named in the "Disaggregated roles" doc,
+# docs/fleet.md — each name cross-checked against the live
+# FleetConfig/stats/recorder surfaces so a rename breaks the lint.
+DISAGG_NAMES = (
+    "replica_roles",
+    "num_handoffs", "num_handoff_requests", "num_handoff_bytes",
+    "num_affinity_probes_skipped",
+    "prefill_handoff",
 )
 # the process-replica surface (mode knob, RPC policy knobs, and the
 # wire-health counters) must be named in the "Process replicas" doc,
@@ -174,6 +186,13 @@ def collect_names():
                 "live FleetConfig field or fleet stats() key — update "
                 "tools/check_docs.py")
         names.append(("autoscale surface", n))
+    for n in DISAGG_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"DISAGG_NAMES lists {n!r}, which is no longer a live "
+                "FleetConfig field, fleet stats() key, or recorder "
+                "event kind — update tools/check_docs.py")
+        names.append(("disagg surface", n))
     return names
 
 
@@ -193,7 +212,8 @@ def main():
             text, where = robustness_text, ROBUSTNESS_DOCS
         elif kind in MESH_KINDS:
             text, where = mesh_text, MESH_DOCS
-        elif kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS:
+        elif (kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS
+                or kind in DISAGG_KINDS):
             text, where = fleet_text, FLEET_DOCS
         else:
             text, where = serving_text, SERVING_DOCS

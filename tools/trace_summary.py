@@ -122,6 +122,18 @@ def summarize(dump: Dict) -> str:
             f"(+{sum(int(e.get('adopted', 0)) for e in fails)} results "
             f"adopted from checkpoints), {len(migs)} migrations moving "
             f"{sum(int(e.get('requests', 0)) for e in migs)} requests")
+    handoffs = [e for e in rec_events
+                if e.get("kind") == "prefill_handoff"]
+    if handoffs:
+        last = handoffs[-1]
+        lines.append(
+            f"-- disaggregation: {len(handoffs)} handoff sweeps moving "
+            f"{sum(int(e.get('requests', 0)) for e in handoffs)} "
+            f"requests prefill->decode "
+            f"({sum(int(e.get('bytes', 0)) for e in handoffs)} payload "
+            f"bytes); queue depths at last handoff: "
+            f"prefill={last.get('prefill_queue', 0)} "
+            f"decode={last.get('decode_queue', 0)}")
     spawns = [e for e in rec_events if e.get("kind") == "replica_spawn"]
     retires = [e for e in rec_events
                if e.get("kind") == "replica_retire"]
