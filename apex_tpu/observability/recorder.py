@@ -49,6 +49,8 @@ RECORDER_EVENT_KINDS = (
     "failover",             # the dead replica's requests re-homed
     "migrate",              # drain-and-migrate moved requests off a replica
     "prefill_handoff",      # disaggregated prefill->decode handoff sweep
+    "shared_publish",       # blocks published into the fleet shared tier
+    "shared_hit",           # shared-tier blocks seeded into a replica
     "replica_spawn",        # the autoscaler grew the fleet by one replica
     "replica_retire",       # the autoscaler drained a replica away
     "rpc_timeout",          # a process-replica RPC exceeded its deadline

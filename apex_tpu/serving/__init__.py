@@ -60,6 +60,7 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     DeviceMirror,
     HostSpillStore,
     KVCache,
+    SharedPrefixStore,
     blocks_needed,
     copy_block,
     default_kv_dtype,

@@ -3770,6 +3770,18 @@ class InferenceEngine:
                 n += 1
         return n
 
+    def spilled_hashes(self) -> Dict[str, str]:
+        """Chain hash -> owning tenant for every entry resident in the
+        local host spill tier — the fleet router's shared-tier publish
+        sweep reads this to learn what this replica evicted (and whose
+        it was), then pulls the payloads it wants through
+        :meth:`export_prefix_payloads`. Read-only, host-side,
+        JSON-friendly: part of the narrow replica surface. Empty with
+        no spill tier configured."""
+        if self.spill is None:
+            return {}
+        return self.spill.entry_tenants()
+
     def decoding_uids(self) -> List[str]:
         """Uids of resident slots whose prefill has COMPLETED (first
         token known, decode phase entered), in admission order. The

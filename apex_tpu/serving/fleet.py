@@ -106,6 +106,7 @@ from apex_tpu.serving.engine import (
 )
 from apex_tpu.serving.kv_cache import (
     DEFAULT_TENANT,
+    SharedPrefixStore,
     blocks_needed,
     seq_block_hashes,
 )
@@ -117,7 +118,9 @@ from apex_tpu.serving.process_replica import (
 )
 from apex_tpu.utils.integrity import (
     IntegrityError,
+    payload_checksum,
     seal_record,
+    verify_payload,
     verify_record,
 )
 
@@ -247,6 +250,25 @@ class FleetConfig:
     # hashes); a spill tier (spill_max_bytes) makes the handoff carry
     # KV instead of recomputing, and is strongly recommended.
     replica_roles: Optional[Sequence[str]] = None
+    # -- fleet-global shared prefix tier (docs/fleet.md, "Shared
+    # prefix tier") ----------------------------------------------------
+    # byte budget of the router-owned SharedPrefixStore: ONE shared,
+    # deduped, checksummed KV tier across all replicas, fed by replica
+    # spill evictions and finished-prefill handoffs and probed at
+    # placement — a prefix prefilled on any replica is warm
+    # fleet-wide, so an affinity-blind route still lands warm. None
+    # (the default): no shared tier, certified bit-identical to the
+    # tier-less fleet. Requires EngineConfig.enable_prefix_caching
+    # (entries are content-addressed by the chain hashes); replicas
+    # need a local spill tier (EngineConfig.spill_max_bytes) to
+    # receive seeds — without one a shared hit silently degrades to
+    # recompute (the tier is an optimization, never a dependency).
+    shared_prefix_bytes: Optional[int] = None
+    # scrub coverage: shared-tier entries re-verified against their
+    # put-time checksums each router tick, round-robin from where the
+    # last pass stopped (the engine spill scrubber's discipline,
+    # walked by the router). 0 disables the shared scrub.
+    shared_scrub_blocks: int = 8
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -332,6 +354,15 @@ class FleetConfig:
                         f"replica_roles needs at least one {need!r} "
                         "replica: a disaggregated fleet without one "
                         "can accept work it can never finish")
+        if (self.shared_prefix_bytes is not None
+                and self.shared_prefix_bytes < 1):
+            raise ValueError(
+                f"shared_prefix_bytes must be >= 1 (or None for no "
+                f"shared tier), got {self.shared_prefix_bytes}")
+        if self.shared_scrub_blocks < 0:
+            raise ValueError(
+                f"shared_scrub_blocks must be >= 0, got "
+                f"{self.shared_scrub_blocks}")
 
 
 @dataclasses.dataclass
@@ -459,6 +490,12 @@ class FleetRouter:
         self._roles: List[str] = (list(self.config.replica_roles)
                                   if self._roles_enabled
                                   else ["mixed"] * n)
+        if (self.config.shared_prefix_bytes is not None
+                and not engine_config.enable_prefix_caching):
+            raise ValueError(
+                "shared_prefix_bytes requires "
+                "EngineConfig.enable_prefix_caching: the shared tier "
+                "is content-addressed by the prefix chain hashes")
         self.replicas: List[_Replica] = [self._spawn(i)
                                          for i in range(n)]
         # fleet-wide request tracking: owner replica per live uid, the
@@ -529,6 +566,25 @@ class FleetRouter:
         self._num_handoff_requests = 0
         self._num_handoff_bytes = 0
         self._num_affinity_probes_skipped = 0
+        # -- fleet-global shared prefix tier (docs/fleet.md, "Shared
+        # prefix tier"): the router-owned store, the per-slot ledger of
+        # hashes each replica already published (publish-once per
+        # slot: refcounts mean "distinct slots holding these bytes",
+        # and the eviction sweep must not re-count a resident entry
+        # every tick), and the flow counters. The hash-walk counter is
+        # unconditional: it pins the placement hot path's one-walk
+        # bound whether or not the tier is on.
+        self._shared: Optional[SharedPrefixStore] = None
+        self._published: List[set] = [set() for _ in range(n)]
+        self._num_shared_publishes = 0
+        self._num_shared_hits = 0
+        self._num_shared_scrub_blocks_verified = 0
+        self._num_hash_walks = 0
+        if self.config.shared_prefix_bytes is not None:
+            self._shared = SharedPrefixStore(
+                self.config.shared_prefix_bytes,
+                verify=engine_config.verify_artifacts,
+                on_corrupt=self._note_shared_corrupt)
         self._sdc_enabled = \
             self.config.sdc_check_interval_ticks is not None
         self._sdc_arrivals: Dict[str, int] = {}
@@ -570,10 +626,16 @@ class FleetRouter:
                 if r.alive and r.engine is not None]
 
     def _seq_hashes(self, tokens: Sequence[int]) -> List[str]:
+        # counted (stats()["num_hash_walks"]) so the placement hot
+        # path's bound — ONE chain-hash walk per placement decision —
+        # stays pinned by test instead of regressing silently
+        self._num_hash_walks += 1
         return seq_block_hashes(tokens, self.engine_config.block_size)
 
     def _ranked(self, seq: Sequence[int],
-                stage: Optional[str] = None) -> List[Tuple[int, int]]:
+                stage: Optional[str] = None,
+                hashes: Optional[List[str]] = None
+                ) -> List[Tuple[int, int]]:
         """Alive replicas as ``(index, matched_blocks)``, best placement
         first (docs/fleet.md, placement score)::
 
@@ -601,7 +663,14 @@ class FleetRouter:
         falls back to ranking every survivor — roles are placement
         policy, not capability, and the zero-lost contract outranks
         specialization. Colocated fleets ignore ``stage`` entirely
-        (bit-identical to the single-stage router)."""
+        (bit-identical to the single-stage router).
+
+        ``hashes`` is the prompt's precomputed chain (a caller that
+        already walked it passes it in; one walk per placement
+        decision). With the shared prefix tier on, its coverage folds
+        into ``cached_fraction`` — the returned ``matched_blocks``
+        stays the replica's LOCAL match (the shared-tier seeding
+        starts where the local match ends)."""
         alive = self._alive()
         if not alive:
             raise FleetFailedError(
@@ -621,7 +690,11 @@ class FleetRouter:
                 alive = pool
             # an empty role group (every specialist of that role is
             # down): degrade to the full-survivor ranking below
-        hashes = self._seq_hashes(seq)
+        if hashes is None:
+            # callers that already walked the chain (migrate's payload
+            # export, the shared-tier seeding in add_request) pass it
+            # in — one walk per placement decision, never two
+            hashes = self._seq_hashes(seq)
         loads = {i: rep.engine.load() for i, rep in alive}
         svc = {i: (ld["ewma_prefill_dispatch_s"]
                    + ld["ewma_decode_dispatch_s"])
@@ -633,7 +706,15 @@ class FleetRouter:
         for i, rep in alive:
             ld = loads[i]
             matched = rep.engine.probe_prefix(hashes)
-            affinity = (matched * bs) / max(len(seq), 1)
+            covered = matched
+            if self._shared is not None:
+                # fold shared-tier coverage into cached_fraction: the
+                # tier serves every replica equally, so the affinity
+                # term stays honest about what a placement would NOT
+                # recompute (an affinity-blind route still lands warm)
+                # while load decides among equally-covered replicas
+                covered += self._shared.probe(hashes, start=matched)
+            affinity = (covered * bs) / max(len(seq), 1)
             backlog = ld["queue_depth"] + ld["active_slots"]
             # a replica with no EWMAs yet (cold, or freshly respawned)
             # weighs its backlog at the neutral 1.0 — NOT 0, which
@@ -744,8 +825,14 @@ class FleetRouter:
                 f"request {uid!r} throttled: tenant "
                 f"{request.tenant!r} {reason}")
         placed = None
-        for idx, matched in self._ranked(list(request.prompt),
-                                         stage="prefill"):
+        prompt = list(request.prompt)
+        hashes: Optional[List[str]] = None
+        if self._shared is not None:
+            # ONE walk serves both the placement ranking and the
+            # post-placement shared-tier seeding
+            hashes = self._seq_hashes(prompt)
+        for idx, matched in self._ranked(prompt, stage="prefill",
+                                         hashes=hashes):
             try:
                 arrival = self.replicas[idx].engine.add_request(request)
             except QueueFullError:
@@ -770,6 +857,11 @@ class FleetRouter:
         self._requests[uid] = request
         self.replicas[idx].routed += 1
         self._num_accepted += 1
+        if hashes:
+            # fleet-wide prefix hit: seed the chosen replica's local
+            # spill tier with the shared-tier run extending its own
+            # match, so its _admit re-admits by the one-scatter upload
+            self._seed_from_shared(idx, hashes, matched)
 
     def try_add(self, request: Request) -> bool:
         """Non-raising variant, mirroring the engine's: False on a
@@ -856,6 +948,7 @@ class FleetRouter:
                     self._fail_replica(i, "no-progress stall")
                     progressed = True
         self._drain_outputs()
+        self._shared_tick()
         self._autoscale_tick()
         self._maybe_sdc_check()
         return progressed
@@ -1247,6 +1340,7 @@ class FleetRouter:
         self._drafters.append(None)
         self._faults.append(None)
         self._roles.append(role)
+        self._published.append(set())
         self.replicas.append(self._spawn(idx))
         self._num_spawned += 1
         if self._obs is not None:
@@ -1300,6 +1394,143 @@ class FleetRouter:
         if self._obs is not None:
             self._obs.record("replica_retire", replica=victim,
                              reason="autoscale", role=role)
+
+    # -- fleet-global shared prefix tier (docs/fleet.md, "Shared
+    # prefix tier") --------------------------------------------------------
+
+    def _note_shared_corrupt(self, site: str, block_hash: str) -> None:
+        """The shared store's ``on_corrupt`` hook (and the publish
+        verifier's): surface every shared-tier detection to the flight
+        recorder under a ``shared_``-prefixed site, mirroring the
+        engine's one-funnel discipline. The discard count itself lives
+        on the store (``num_shared_corrupt_discards``)."""
+        if self._obs is not None:
+            self._obs.record("corruption_detected",
+                             site=f"shared_{site}",
+                             detail=str(block_hash))
+
+    def _publish_payload(self, block_hash: str, payload: Dict,
+                         tenant: str) -> bool:
+        """Verify one transported payload end-to-end (against the
+        detached checksum the export attached), then publish it into
+        the shared tier. A mismatch is transport rot: reported and
+        skipped — the shared tier must never launder corrupt bytes
+        fleet-wide, and a skip just means the block stays a miss."""
+        payload = dict(payload)
+        checksum = payload.pop("checksum", None)
+        if (self.engine_config.verify_artifacts
+                and checksum is not None):
+            try:
+                verify_payload(payload, checksum, "shared_publish")
+            except IntegrityError:
+                self._note_shared_corrupt("publish", block_hash)
+                return False
+        if self._shared.publish(block_hash, payload, tenant=tenant):
+            self._num_shared_publishes += 1
+            return True
+        return False
+
+    def _shared_tick(self) -> None:
+        """The per-tick shared-tier sweep (a no-op with the tier off —
+        certified bit-identical to the tier-less fleet). PUBLISH: every
+        local-spill entry a replica holds that its slot has not
+        published yet enters the tier — payloads ride
+        ``export_prefix_payloads`` (the framed-RPC spill surface
+        process replicas already speak), entries the tier already holds
+        publish as dedupe references (no bytes moved). Then SCRUB
+        ``shared_scrub_blocks`` entries round-robin (the engine spill
+        scrubber's budgeted-cursor discipline, walked by the router)
+        and audit the refcount/ownership/byte ledger."""
+        if self._shared is None:
+            return
+        for i, rep in self._alive():
+            try:
+                spilled = rep.engine.spilled_hashes()
+            except ReplicaUnavailableError:
+                continue
+            fresh = [h for h in spilled
+                     if h not in self._published[i]]
+            if not fresh:
+                continue
+            need = [h for h in fresh if h not in self._shared]
+            payloads: Dict[str, Dict] = {}
+            if need:
+                try:
+                    payloads = rep.engine.export_prefix_payloads(need)
+                except ReplicaUnavailableError:
+                    continue
+            stored = 0
+            nbytes = 0
+            for h in fresh:
+                if h in self._shared:
+                    # content-addressed dedupe: the same hash from a
+                    # second slot adds a reference and an ownership
+                    # share, never a second copy
+                    self._shared.publish(h, None, tenant=spilled[h])
+                    self._published[i].add(h)
+                    continue
+                payload = payloads.get(h)
+                if payload is None:
+                    # rotted (and discarded) mid-export, or past an
+                    # export gap: not published, retried next tick
+                    continue
+                if self._publish_payload(h, payload, spilled[h]):
+                    stored += 1
+                    nbytes += self._payload_nbytes({h: payload})
+                self._published[i].add(h)
+            if stored and self._obs is not None:
+                self._obs.record("shared_publish", replica=i,
+                                 blocks=stored, bytes=nbytes)
+        n = self.config.shared_scrub_blocks
+        if n > 0:
+            verified, _ = self._shared.scrub(n)
+            self._num_shared_scrub_blocks_verified += verified
+        # the dedupe/byte ledger audit every tick — cheap, host-side,
+        # and a violated shared ledger has no safe degradation
+        self._shared.check_integrity()
+
+    def _seed_from_shared(self, idx: int, hashes: Sequence[str],
+                          matched: int) -> int:
+        """The fleet-wide prefix HIT path: fetch the contiguous
+        shared-tier run extending what replica ``idx`` already serves
+        (device index, then local spill — ``matched``) and seed it
+        into the replica's local spill tier through
+        ``import_prefix_payloads`` (the framed-RPC spill transport in
+        process mode). The replica's next ``_admit`` finds a
+        contiguous spilled run and re-admits it via the existing
+        one-scatter upload path — token-identical to recompute, by the
+        spill-tier equivalence cert. Returns blocks accepted (0
+        without a local spill tier on the replica: the tier is an
+        optimization, never a dependency)."""
+        if self._shared is None:
+            return 0
+        payloads: Dict[str, Dict] = {}
+        n = int(matched)
+        while n < len(hashes) and hashes[n] in self._shared:
+            payload = self._shared.fetch(hashes[n])
+            if payload is None:
+                break   # rot: discarded with its references — a miss
+            if self.engine_config.verify_artifacts:
+                # the detached transport checksum, same as the
+                # replica-to-replica export path — the importing
+                # engine verifies the bytes end to end
+                payload["checksum"] = payload_checksum(payload)
+            payloads[hashes[n]] = payload
+            n += 1
+        if not payloads:
+            return 0
+        try:
+            accepted = self.replicas[idx].engine.import_prefix_payloads(
+                payloads)
+        except ReplicaUnavailableError:
+            return 0
+        if accepted:
+            self._num_shared_hits += accepted
+            if self._obs is not None:
+                self._obs.record("shared_hit", replica=idx,
+                                 blocks=accepted,
+                                 bytes=self._payload_nbytes(payloads))
+        return accepted
 
     # -- disaggregated handoff (docs/fleet.md, "Disaggregated roles") ------
 
@@ -1370,6 +1601,10 @@ class FleetRouter:
         rep = self.replicas[idx]
         rep.alive = False
         rep.error = reason
+        # the slot's publish ledger dies with its spill tier: a
+        # respawn into the slot starts cold and may legitimately
+        # re-publish (a fresh reference from a fresh holder)
+        self._published[idx] = set()
         self._num_replicas_down += 1
         if self._obs is not None:
             self._obs.record("replica_down", replica=idx,
@@ -1621,12 +1856,20 @@ class FleetRouter:
             uid = rec["uid"]
             seq = (list(rec["prompt"])
                    + list(rec.get("generated", ()))[:-1])
+            # ONE chain-hash walk per placement decision: the payload
+            # export, the handoff publish, and the placement ranking
+            # below all read the same chain
+            hashes = self._seq_hashes(seq)
             payloads = None
             if self.config.migrate_spill_payloads:
-                payloads = rep.engine.export_prefix_payloads(
-                    self._seq_hashes(seq))
+                payloads = rep.engine.export_prefix_payloads(hashes)
                 if payloads:
                     nbytes += self._payload_nbytes(payloads)
+            if payloads and _handoff and self._shared is not None:
+                # publish-then-import: the prefill specialist's work
+                # becomes visible FLEET-WIDE before (not instead of)
+                # the decode target's point-to-point import below
+                self._publish_handoff(src, rec, payloads)
             if dst is not None:
                 idx = dst
             else:
@@ -1634,7 +1877,8 @@ class FleetRouter:
                 # history is mid-decode (rank the decode specialists),
                 # a plain waiting entry still needs its prefill
                 stage = "decode" if rec.get("generated") else "prefill"
-                ranked = [i for i, _ in self._ranked(seq, stage)
+                ranked = [i for i, _
+                          in self._ranked(seq, stage, hashes=hashes)
                           if i != src]
                 idx = ranked[0] if ranked else src
             target = self.replicas[idx].engine
@@ -1685,6 +1929,30 @@ class FleetRouter:
                         prefill_queue=self._role_backlog("prefill"),
                         decode_queue=self._role_backlog("decode"))
         return moved
+
+    def _publish_handoff(self, src: int, rec: Dict,
+                         payloads: Mapping[str, Dict]) -> None:
+        """Publish one handoff's exported KV payloads into the shared
+        tier, attributed to the request's tenant — the
+        publish-then-import half of ``_handoff_tick``. Hashes the
+        source slot already published become dedupe references; the
+        publish-once-per-slot ledger keeps repeated handoffs of the
+        same hot prefix from inflating refcounts."""
+        tenant = str(rec.get("tenant", DEFAULT_TENANT))
+        stored = 0
+        nbytes = 0
+        for h, payload in payloads.items():
+            if h in self._published[src]:
+                continue
+            if h in self._shared:
+                self._shared.publish(h, None, tenant=tenant)
+            elif self._publish_payload(h, payload, tenant):
+                stored += 1
+                nbytes += self._payload_nbytes({h: payload})
+            self._published[src].add(h)
+        if stored and self._obs is not None:
+            self._obs.record("shared_publish", replica=src,
+                             blocks=stored, bytes=nbytes)
 
     def _role_backlog(self, role: str) -> int:
         """Summed backlog (waiting + active lanes) over the alive
@@ -1778,6 +2046,7 @@ class FleetRouter:
             self._drain_replica_outputs(rep.engine)
             rep.alive = False
             rep.error = "retired"
+            self._published[src] = set()
             if rep.mode == "process":
                 # clean shutdown of the child; a closed handle cannot
                 # serve stats, so the slot drops the object
@@ -1877,6 +2146,30 @@ class FleetRouter:
             "num_handoff_bytes": self._num_handoff_bytes,
             "num_affinity_probes_skipped":
                 self._num_affinity_probes_skipped,
+            # fleet-global shared prefix tier (docs/fleet.md, "Shared
+            # prefix tier"): resident gauges, the publish/dedupe/hit
+            # flow, eviction/refusal/corruption tallies and the scrub
+            # coverage (all 0 with the tier off), plus the placement
+            # hash-walk counter whose one-walk-per-decision bound the
+            # regression test pins
+            "shared_tier_blocks": (0 if self._shared is None
+                                   else len(self._shared)),
+            "shared_tier_bytes": (0 if self._shared is None
+                                  else int(self._shared.total_bytes)),
+            "shared_tier_hits": self._num_shared_hits,
+            "num_shared_publishes": self._num_shared_publishes,
+            "num_shared_dedupe": (0 if self._shared is None
+                                  else int(self._shared.dedupe_hits)),
+            "num_shared_evictions": (0 if self._shared is None
+                                     else int(self._shared.evictions)),
+            "num_shared_refused": (0 if self._shared is None
+                                   else int(self._shared.refused)),
+            "num_shared_corrupt_discards":
+                (0 if self._shared is None
+                 else int(self._shared.corrupt_discards)),
+            "num_shared_scrub_blocks_verified":
+                self._num_shared_scrub_blocks_verified,
+            "num_hash_walks": self._num_hash_walks,
             "num_lost_requests": (self._num_accepted - len(self._owner)
                                   - self._num_terminal),
             "queue_depth": sum(rep.engine.queue_depth
@@ -1894,13 +2187,19 @@ class FleetRouter:
         summed (tokens, waiting, residency, fractional charge, engine
         statuses), the router's own door tallies merged in, and the
         FLEET rate estimate (the number ``FleetConfig.tenant_quotas``'
-        ``tokens_per_s`` is enforced against)."""
+        ``tokens_per_s`` is enforced against). With the shared prefix
+        tier on, each tenant's ``shared_tier_bytes`` carries its
+        fractional ownership charge (bytes split by publisher share —
+        the shared-tier leg of the fractional block ledger) and a
+        ``__shared__`` row carries the tier's resident total, so the
+        per-tenant charges visibly sum to the tier."""
         agg: Dict[str, Dict[str, object]] = {}
 
         def row(t: str) -> Dict[str, object]:
             return agg.setdefault(t, {
                 "tokens": 0, "waiting": 0, "resident_slots": 0,
                 "resident_block_charge": 0.0,
+                "shared_tier_bytes": 0.0,
                 "rate_tokens_per_s": round(self._tenant_rate_now(t), 6),
                 "statuses": {},
             })
@@ -1916,6 +2215,11 @@ class FleetRouter:
                     + er.get("resident_block_charge", 0.0), 6)
                 for s, c in (er.get("statuses") or {}).items():
                     r["statuses"][s] = r["statuses"].get(s, 0) + c
+        if self._shared is not None:
+            for t, b in self._shared.tenant_bytes().items():
+                row(t)["shared_tier_bytes"] = b
+            row("__shared__")["shared_tier_bytes"] = round(
+                float(self._shared.total_bytes), 6)
         for t, tally in self._tenant_status.items():
             r = row(t)
             for s, c in tally.items():

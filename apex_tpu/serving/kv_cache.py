@@ -957,6 +957,14 @@ class HostSpillStore:
     def hashes(self):
         return self._entries.keys()
 
+    def entry_tenants(self) -> Dict[str, str]:
+        """Chain hash -> owning tenant for every resident entry — the
+        fleet router's shared-tier publish sweep reads this to carry
+        attribution across the transport (JSON-friendly: part of the
+        narrow replica surface)."""
+        return {h: str(rec["tenant"])
+                for h, rec in self._entries.items()}
+
     def _drop(self, block_hash: str) -> None:
         rec = self._entries.pop(block_hash)
         self.total_bytes -= rec["bytes"]
@@ -986,8 +994,10 @@ class HostSpillStore:
             "checksum": checksum}
         self.total_bytes += nbytes
         while self.total_bytes > self.max_bytes:
-            _, rec = self._entries.popitem(last=False)
-            self.total_bytes -= rec["bytes"]
+            # every removal funnels through _drop so subclasses that
+            # keep per-entry side tables (SharedPrefixStore's refcounts
+            # and ownership shares) stay consistent under eviction
+            self._drop(next(iter(self._entries)))
             self.evictions += 1
         return block_hash in self._entries
 
@@ -1113,6 +1123,152 @@ class HostSpillStore:
             "refused": int(self.refused),
             "corrupt_discards": int(self.corrupt_discards),
         }
+
+
+class SharedPrefixStore(HostSpillStore):
+    """The FLEET-level shared prefix tier (docs/fleet.md, "Shared
+    prefix tier"): one byte-budgeted, content-addressed store the
+    router owns, fed by replica evictions and finished-prefill
+    handoffs, probed at placement so a prefix prefilled on any replica
+    is warm fleet-wide. Same checksummed-entry discipline as the
+    per-replica :class:`HostSpillStore` it extends — put-time SHA-256
+    checksums re-verified at every read and by the round-robin
+    :meth:`scrub`, corrupt entries discarded-and-recomputed, LRU past
+    ``max_bytes`` — plus the two things a SHARED tier needs:
+
+    **Refcounted dedupe.** Entries are content-addressed by chain
+    hash, so the same prefix published from two replicas stores ONCE:
+    a re-publish of a resident hash adds a reference (and an ownership
+    share) instead of bytes, counted in ``dedupe_hits``. Eviction and
+    corruption discards drop the entry with all its references — the
+    tier is a cache, and a reference is attribution, not a pin.
+
+    **Fractional ownership attribution.** Each entry carries per-tenant
+    publisher shares; :meth:`tenant_bytes` charges an entry's bytes to
+    its owning tenants proportionally (the fractional block ledger
+    discipline, applied to the shared tier), which is what the fleet's
+    ``stats()["tenants"]`` ``shared_tier_bytes`` rows read.
+    :meth:`check_integrity` audits the refcount/share/byte invariants
+    the same way the allocator audits its ledger."""
+
+    def __init__(self, max_bytes: int, verify: bool = True,
+                 corrupt_hook=None, on_corrupt=None):
+        super().__init__(max_bytes, verify=verify,
+                         corrupt_hook=corrupt_hook,
+                         on_corrupt=on_corrupt)
+        # per-resident-hash publisher refcount, and the per-tenant
+        # share split of that refcount (sums to it; audited)
+        self._refs: Dict[str, int] = {}
+        self._owners: Dict[str, Dict[str, int]] = {}
+        self.dedupe_hits = 0   # publishes deduped against a resident entry
+
+    def _drop(self, block_hash: str) -> None:
+        super()._drop(block_hash)
+        self._refs.pop(block_hash, None)
+        self._owners.pop(block_hash, None)
+
+    def publish(self, block_hash: str,
+                payload: Optional[Dict[str, np.ndarray]] = None,
+                tenant: str = DEFAULT_TENANT) -> bool:
+        """Content-addressed insert with refcounted dedupe. A resident
+        hash gains a reference and an ownership share — no bytes
+        stored, no payload needed (``payload=None`` is the publisher
+        saying "I hold these bytes too"), and the entry refreshes to
+        MRU (a re-publish is evidence of fleet-wide heat). A new hash
+        needs its payload and follows :meth:`HostSpillStore.put`
+        semantics (checksum at the source, byte-bound LRU eviction).
+        Returns whether the entry is resident after the call."""
+        if block_hash in self._entries:
+            self.dedupe_hits += 1
+            self._refs[block_hash] += 1
+            shares = self._owners[block_hash]
+            shares[tenant] = shares.get(tenant, 0) + 1
+            self._entries.move_to_end(block_hash)
+            return True
+        if payload is None:
+            return False
+        if self.put(block_hash, payload, tenant=tenant):
+            self._refs[block_hash] = 1
+            self._owners[block_hash] = {tenant: 1}
+            return True
+        return False
+
+    def fetch(self, block_hash: str
+              ) -> Optional[Dict[str, np.ndarray]]:
+        """A deep-copied payload for seeding a replica's local spill
+        tier (None on miss or checksum mismatch — a corrupt entry is
+        discarded with its references and served by recompute). A PEEK
+        like :meth:`export_entry` — the tier keeps serving the other
+        replicas — but a fetch IS a hit, so the entry refreshes to MRU
+        (export_entry's transport reads deliberately do not)."""
+        payload = self.export_entry(block_hash)
+        if payload is not None:
+            self._entries.move_to_end(block_hash)
+        return payload
+
+    def probe(self, hashes: Sequence[str], start: int = 0) -> int:
+        """Length of the contiguous resident run of ``hashes``
+        beginning at ``start`` — the placement-time coverage probe
+        (read-only; same leading-run discipline as the engine's
+        prefix match)."""
+        n = int(start)
+        while n < len(hashes) and hashes[n] in self._entries:
+            n += 1
+        return n - int(start)
+
+    def tenant_bytes(self) -> Dict[str, float]:
+        """Per-tenant fractional byte charge: each entry's bytes split
+        across its owning tenants by publisher share (an entry two
+        tenants each published once charges half to each)."""
+        out: Dict[str, float] = {}
+        for h, rec in self._entries.items():
+            refs = self._refs.get(h, 1)
+            for t, n in (self._owners.get(h) or {}).items():
+                out[t] = out.get(t, 0.0) + rec["bytes"] * n / refs
+        return {t: round(v, 6) for t, v in out.items()}
+
+    def check_integrity(self) -> None:
+        """Audit the refcount/ownership/byte invariants (raises
+        ``ValueError`` — a violated shared ledger has no safe
+        degradation): every resident entry has a positive refcount
+        whose per-tenant shares sum to it exactly, no side-table row
+        outlives its entry, and the byte accumulator equals the sum of
+        resident entry sizes within the budget."""
+        total = sum(int(rec["bytes"]) for rec in self._entries.values())
+        if total != self.total_bytes:
+            raise ValueError(
+                f"shared tier byte accumulator {self.total_bytes} != "
+                f"sum of resident entries {total}")
+        if self.total_bytes > self.max_bytes:
+            raise ValueError(
+                f"shared tier holds {self.total_bytes} bytes over its "
+                f"budget {self.max_bytes}")
+        for name, table in (("refcount", self._refs),
+                            ("ownership", self._owners)):
+            if set(table) != set(self._entries):
+                stray = set(table) ^ set(self._entries)
+                raise ValueError(
+                    f"shared tier {name} table out of sync with the "
+                    f"resident entries (mismatched hashes: "
+                    f"{sorted(stray)[:3]})")
+        for h, refs in self._refs.items():
+            if refs < 1:
+                raise ValueError(
+                    f"shared entry {h!r} has refcount {refs} < 1")
+            shares = self._owners[h]
+            if any(n < 1 for n in shares.values()):
+                raise ValueError(
+                    f"shared entry {h!r} has a non-positive ownership "
+                    f"share: {shares}")
+            if sum(shares.values()) != refs:
+                raise ValueError(
+                    f"shared entry {h!r} ownership shares {shares} do "
+                    f"not sum to its refcount {refs}")
+
+    def stats(self) -> Dict[str, int]:
+        out = super().stats()
+        out["dedupe_hits"] = int(self.dedupe_hits)
+        return out
 
 
 class DeviceMirror:

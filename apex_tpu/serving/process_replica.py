@@ -426,6 +426,10 @@ class ProcessReplica:
     def probe_prefix(self, hashes: Sequence[str]) -> int:
         return int(self._call("probe_prefix", list(hashes)))
 
+    def spilled_hashes(self) -> Dict[str, str]:
+        return {str(h): str(t)
+                for h, t in self._call("spilled_hashes").items()}
+
     def decoding_uids(self) -> List[str]:
         return [str(u) for u in self._call("decoding_uids")]
 

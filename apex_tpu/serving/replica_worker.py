@@ -88,6 +88,9 @@ class _Servicer:
             return eng.load(), {}
         if method == "probe_prefix":
             return int(eng.probe_prefix(list(args[0]))), {}
+        if method == "spilled_hashes":
+            return {str(h): str(t)
+                    for h, t in eng.spilled_hashes().items()}, {}
         if method == "decoding_uids":
             return [str(u) for u in eng.decoding_uids()], {}
         if method == "exported_arrival":

@@ -3303,6 +3303,250 @@ def bench_serving_disagg(fast=False):
     }
 
 
+def bench_serving_shared_prefix(fast=False):
+    """Fleet-global shared prefix tier arm (round 18, docs/fleet.md
+    "Shared prefix tier"): one router-owned, refcount-deduped,
+    byte-budgeted KV tier vs per-replica spill at EQUAL device count
+    and EQUAL total spill bytes, on an affinity-blind shared-prefix
+    trace built to expose what private tiers cannot hold — an ODD
+    number of rotating shared prefixes (odd so paired placement can't
+    accidentally partition them by replica parity: BOTH replicas see
+    EVERY prefix, the affinity-blind worst case) whose deduped
+    working set fits the shared budget while the duplicated
+    per-replica demand overflows each local LRU.
+
+    Three phases: (1) per-replica baseline — 2 replicas with
+    ``affinity_weight=0`` and the whole byte budget split into two
+    local spill tiers, each smaller than the full prefix set it must
+    hold privately, so steady state keeps missing; (2) the SAME trace
+    on the shared arm — local tiers just big enough to land a seeded
+    run, the rest of the budget as ``shared_prefix_bytes`` holding
+    the DEDUPED set once — asserting the fleet-wide prefix hit rate
+    ((hit+spilled-in blocks)/looked-up blocks, summed over replicas)
+    BEATS the per-replica arm, steady-state TTFT p99 (scheduler
+    ticks, cold warmup excluded) strictly improves, publishes/dedupe/
+    hits all moved, and outputs are token-identical across arms (the
+    tier is an optimization, never a token source; the trace is
+    greedy so each prefix's generated suffix chain dedupes too —
+    sampled/int8/spec coverage lives in tests/test_shared_prefix.py);
+    (3) chaos — a replica is hard-killed mid-trace with the tier on:
+    failover must finish every accepted request with
+    ``num_lost_requests == 0`` (the shared tier holds no request
+    state, only re-derivable KV bytes). ``vs_baseline`` is
+    per-replica hit rate / shared hit rate (< 1 = the shared tier
+    pays). ``fast=True`` is the tier-1 smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.observability import percentile
+    from apex_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                  Request, SamplingParams)
+
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    # a SMALL device pool: prefix blocks must be evicted into the
+    # spill tiers for either arm to have anything to serve
+    ekw = dict(max_batch=2, block_size=4, num_blocks=8,
+               max_prefill_len=8, max_seq_len=32,
+               enable_prefix_caching=True, snapshot_interval_ticks=2,
+               max_waiting=64, seed=11)
+    # one 4-token block of fp32 K+V under GPTConfig.tiny (n_embd=128):
+    # 2 * 4 * 128 * 4 B — the unit both arms' byte budgets are set in
+    blk = 4096
+    npref = 7          # ODD (see docstring); 7-block (28-token) heads
+    n_reqs = 28 if fast else 56   # 4 / 8 visits per prefix
+    kill_pair = 4 if fast else 10
+    # EQUAL total spill bytes. Each finished sequence is 8 blocks (28
+    # prompt + 4 generated), so the deduped greedy working set is
+    # 7 x 8 = 56 blocks. Shared arm: 8-block local tiers (a seeded
+    # 7-block run must FIT the landing tier or the import evicts its
+    # own head) + a 60-block shared tier holding the set once.
+    # Per-replica arm: the same 76-block total split into two 38-block
+    # local tiers — each replica needs all 56 blocks privately, so
+    # its LRU cycles and steady state keeps missing.
+    local_small, shared_bytes = 8 * blk, 60 * blk
+    per_replica_local = (2 * local_small + shared_bytes) // 2
+    model = GPTLMHeadModel(cfg)
+    # FIXED seeds (not _SALT): the arm asserts a hit-rate ORDERING
+    # between two fleets on one trace — the trace must be the same
+    # every round or the assert flakes
+    init_rng = np.random.RandomState(1712)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(init_rng.randint(0, cfg.vocab_size, (1, 8))))
+
+    def make_trace():
+        rng = np.random.RandomState(1713)
+        prefixes = [list(rng.randint(0, cfg.vocab_size, 28))
+                    for _ in range(npref)]
+
+        def make(k):
+            prompt = prefixes[k % npref]
+            return lambda: Request(uid=f"q{k}", prompt=list(prompt),
+                                   max_new_tokens=4,
+                                   sampling=SamplingParams())
+
+        return [make(k) for k in range(n_reqs)]
+
+    def drive(router, trace, kill_pair_at=None, kill_idx=None):
+        """Submit in PAIRS (backlog spreads a pair across replicas —
+        load-only ties would otherwise pile onto slot 0), DRAINING
+        between pairs: publishes need device churn to evict blocks
+        into the tiers before the next placement probes them, and a
+        drained queue keeps the placement-time shared-tier seed
+        adjacent to its admission. Per-uid submit/first-token ticks
+        via the stream feed."""
+        submit, first, accepted = {}, {}, []
+        t0 = time.perf_counter()
+        tick = 0
+        for i in range(0, len(trace), 2):
+            if (kill_pair_at is not None and i // 2 == kill_pair_at
+                    and router.replicas[kill_idx].alive):
+                router.kill_replica(kill_idx)
+            for k in (i, i + 1):
+                if k < len(trace):
+                    req = trace[k]()
+                    if router.try_add(req):
+                        submit[req.uid] = tick
+                        accepted.append(req.uid)
+            while router.has_work:
+                router.step()
+                for uid, tok, _last in router.pop_stream_events():
+                    if tok >= 0 and uid not in first and uid in submit:
+                        first[uid] = tick
+                tick += 1
+        wall = time.perf_counter() - t0
+        ttft = {u: first[u] - submit[u] for u in first}
+        return ttft, accepted, wall
+
+    def fleet_hit_rate(router):
+        """(prefix hits + spill/shared re-admissions) / lookups, in
+        BLOCKS, summed over alive replicas — shared-tier seeds land
+        in the chosen replica's local spill and re-admit through the
+        same upload path, so ``spill_hits`` is the one re-admission
+        unit both arms share."""
+        hit = lookups = 0
+        for rep in router.replicas:
+            if rep.alive and rep.engine is not None:
+                s = rep.engine.stats()
+                hit += int(s["prefix_hit_blocks"]) + int(s["spill_hits"])
+                lookups += int(s["prefix_lookup_blocks"])
+        return hit / max(lookups, 1)
+
+    def pct(xs, q):
+        return percentile(xs, q) if xs else 0.0
+
+    def steady(ttft):
+        # the steady-state window: the trace's second half, every
+        # prefix long since first-seen — cold compulsory misses
+        # (identical in both arms) would otherwise drown the tail
+        return [ttft[f"q{k}"] for k in range(n_reqs // 2, n_reqs)
+                if f"q{k}" in ttft]
+
+    # -- phase 1: per-replica baseline (whole budget split local) --
+    trace = make_trace()
+    perrep = FleetRouter(
+        model, params,
+        EngineConfig(spill_max_bytes=per_replica_local, **ekw),
+        FleetConfig(num_replicas=2, affinity_weight=0.0))
+    ttft_pr, acc_pr, wall_pr = drive(perrep, trace)
+    pr_res = perrep.run(return_status=True)
+    pr_stats = perrep.stats()
+    rate_pr = fleet_hit_rate(perrep)
+    assert not (set(acc_pr) - set(pr_res)), "per-replica arm lost requests"
+    assert pr_stats["num_lost_requests"] == 0
+    p99_pr = pct(steady(ttft_pr), 99)
+
+    # -- phase 2: the same trace, shared tier at equal total bytes --
+    shared = FleetRouter(
+        model, params,
+        EngineConfig(spill_max_bytes=local_small, **ekw),
+        FleetConfig(num_replicas=2, affinity_weight=0.0,
+                    shared_prefix_bytes=shared_bytes))
+    ttft_sh, acc_sh, wall_sh = drive(shared, trace)
+    sh_res = shared.run(return_status=True)
+    sh_stats = shared.stats()
+    rate_sh = fleet_hit_rate(shared)
+    assert not (set(acc_sh) - set(sh_res)), "shared arm lost requests"
+    assert sh_stats["num_lost_requests"] == 0
+    assert sh_stats["num_shared_publishes"] >= 1, "nothing published"
+    assert sh_stats["num_shared_dedupe"] >= 1, (
+        "no dedupe: both replicas' evictions of one prefix should "
+        "collide in the shared tier")
+    assert sh_stats["shared_tier_hits"] >= 1, "no shared-tier hit"
+    # the tier is an optimization, never a token source: both arms
+    # produce the SAME tokens for every request
+    assert set(pr_res) == set(sh_res)
+    for uid in pr_res:
+        assert list(pr_res[uid].tokens) == list(sh_res[uid].tokens), (
+            f"{uid}: shared-tier tokens diverged from per-replica")
+    p99_sh = pct(steady(ttft_sh), 99)
+    # the headline ordering: ONE deduped copy reachable by every
+    # replica beats N private copies that each overflow
+    assert rate_sh > rate_pr, (
+        f"shared-tier fleet hit rate {rate_sh:.3f} did not beat "
+        f"per-replica {rate_pr:.3f} at equal total spill bytes")
+    assert p99_sh < p99_pr, (
+        f"steady-state TTFT p99 {p99_sh} ticks (shared) did not beat "
+        f"per-replica {p99_pr}")
+
+    # -- phase 3: a replica hard-killed mid-trace, tier on --
+    chaos = FleetRouter(
+        model, params,
+        EngineConfig(spill_max_bytes=local_small, **ekw),
+        FleetConfig(num_replicas=2, affinity_weight=0.0,
+                    shared_prefix_bytes=shared_bytes, respawn=True))
+    _, acc_kill, _ = drive(chaos, trace, kill_pair_at=kill_pair,
+                           kill_idx=0)
+    kill_res = chaos.run(return_status=True)
+    kill_stats = chaos.stats()
+    missing = set(acc_kill) - set(kill_res)
+    assert not missing, f"lost accepted requests: {sorted(missing)}"
+    assert kill_stats["num_lost_requests"] == 0
+    assert kill_stats["num_failovers"] >= 1, "the kill never fired"
+    for rep in chaos.replicas:
+        if rep.alive and rep.engine is not None:
+            rep.engine.check_allocator_integrity()
+
+    print(f"# serving shared prefix: per-replica hit rate "
+          f"{rate_pr:.3f} (steady p99 TTFT {p99_pr:.0f} ticks) | "
+          f"shared {rate_sh:.3f} (steady p99 {p99_sh:.0f}), "
+          f"{sh_stats['num_shared_publishes']} published / "
+          f"{sh_stats['num_shared_dedupe']} deduped / "
+          f"{sh_stats['shared_tier_hits']} hits / "
+          f"{sh_stats['num_shared_evictions']} evictions, tier "
+          f"{sh_stats['shared_tier_blocks']} blocks "
+          f"{sh_stats['shared_tier_bytes']} B | kill: failovers "
+          f"{kill_stats['num_failovers']}, lost "
+          f"{kill_stats['num_lost_requests']}", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_shared_prefix_fleet_hit_rate",
+        "value": round(float(rate_sh), 4),
+        "unit": "hit_fraction",
+        # the dedupe win: per-replica hit rate over shared hit rate
+        # at equal total spill bytes (< 1 = the shared tier pays)
+        "vs_baseline": round(float(rate_pr) / max(float(rate_sh),
+                                                  1e-9), 4),
+        "per_replica_hit_rate": round(float(rate_pr), 4),
+        "shared_steady_ttft_p99_ticks": round(float(p99_sh), 2),
+        "per_replica_steady_ttft_p99_ticks": round(float(p99_pr), 2),
+        "total_spill_bytes_per_arm": 2 * local_small + shared_bytes,
+        "num_offered": len(trace),
+        "num_accepted_shared": len(acc_sh),
+        "num_shared_publishes": int(sh_stats["num_shared_publishes"]),
+        "num_shared_dedupe": int(sh_stats["num_shared_dedupe"]),
+        "shared_tier_hits": int(sh_stats["shared_tier_hits"]),
+        "num_shared_evictions": int(sh_stats["num_shared_evictions"]),
+        "shared_tier_blocks": int(sh_stats["shared_tier_blocks"]),
+        "shared_tier_bytes": int(sh_stats["shared_tier_bytes"]),
+        "tokens_identical_across_arms": True,
+        "kill_num_failovers": int(kill_stats["num_failovers"]),
+        "kill_num_lost_requests": int(kill_stats["num_lost_requests"]),
+        "zero_lost": True,
+        "status_counts": {
+            s: sum(r.status == s for r in sh_res.values())
+            for s in {r.status for r in sh_res.values()}},
+        "allocator_integrity_ok": True,
+    }
+
+
 def bench_obs_pipeline(fast=False):
     """Observability pipeline certification (docs/observability.md):
     drive a small engine with the full observer attached (tracer +
@@ -3427,6 +3671,8 @@ def main():
              lambda: bench_serving_process(fast=True)),
             ("bench_serving_disagg",
              lambda: bench_serving_disagg(fast=True)),
+            ("bench_serving_shared_prefix",
+             lambda: bench_serving_shared_prefix(fast=True)),
             ("bench_train_step", lambda: bench_train_step(fast=True)),
             ("bench_obs_pipeline", lambda: bench_obs_pipeline(fast=True)),
         ):
@@ -3494,7 +3740,7 @@ def main():
                  bench_serving_multitenant, bench_serving_kv_memory,
                  bench_serving_fleet, bench_serving_integrity,
                  bench_serving_mesh, bench_serving_process,
-                 bench_serving_disagg,
+                 bench_serving_disagg, bench_serving_shared_prefix,
                  bench_train_step, bench_obs_pipeline]
     if on_tpu:
         secondary.append(bench_scaled_masked_softmax)

@@ -549,6 +549,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_mesh_decode_tokens_per_sec",
         "serving_tiny_process_kill_goodput_tok_per_sec",
         "serving_tiny_disagg_ttft_p99_ticks",
+        "serving_tiny_shared_prefix_fleet_hit_rate",
         "train_step_tiny_smoke_fused_steps_per_sec",
         "obs_pipeline_smoke_requests_summarized",
     }
@@ -717,6 +718,30 @@ def test_bench_smoke_mode_every_section_rc0():
     assert dg["status_counts"].get("finished", 0) > 0, dg
     assert dg["allocator_integrity_ok"] is True, dg
     assert math.isfinite(dg["vs_baseline"]) and dg["value"] > 0, dg
+    # the shared-prefix-tier arm (docs/fleet.md "Shared prefix tier")
+    # must prove the fleet-global cache story: the shared arm beat
+    # the per-replica arm's fleet-wide hit rate AND steady-state TTFT
+    # p99 at equal total spill bytes, dedupe/publish/hit all moved,
+    # outputs stayed token-identical across arms, and the mid-trace
+    # replica kill lost nothing — a tier that never dedupes or never
+    # serves a fleet-wide hit would be a quiet capacity lie
+    sp = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_shared_prefix_fleet_hit_rate"][0]
+    assert sp["vs_baseline"] < 1.0, sp
+    assert sp["value"] > sp["per_replica_hit_rate"], sp
+    assert (sp["shared_steady_ttft_p99_ticks"]
+            < sp["per_replica_steady_ttft_p99_ticks"]), sp
+    assert sp["num_shared_publishes"] >= 1, sp
+    assert sp["num_shared_dedupe"] >= 1, sp
+    assert sp["shared_tier_hits"] >= 1, sp
+    assert sp["tokens_identical_across_arms"] is True, sp
+    assert sp["zero_lost"] is True, sp
+    assert sp["kill_num_failovers"] >= 1, sp
+    assert sp["kill_num_lost_requests"] == 0, sp
+    assert sp["status_counts"].get("finished", 0) > 0, sp
+    assert sp["allocator_integrity_ok"] is True, sp
+    assert math.isfinite(sp["vs_baseline"]) and sp["value"] > 0, sp
     # the observability pipeline arm (docs/observability.md) certifies
     # dump -> trace_summary end to end AND re-checks zero perturbation
     ob = [r for r in records
@@ -736,7 +761,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving_multitenant", "bench_serving_kv_memory",
         "bench_serving_fleet", "bench_serving_integrity",
         "bench_serving_mesh", "bench_serving_process",
-        "bench_serving_disagg",
+        "bench_serving_disagg", "bench_serving_shared_prefix",
         "bench_train_step", "bench_obs_pipeline",
     }
     for rec in sections.values():

@@ -40,6 +40,7 @@ MESH_KINDS = ("mesh surface",)
 PROCESS_KINDS = ("process surface",)
 AUTOSCALE_KINDS = ("autoscale surface",)
 DISAGG_KINDS = ("disagg surface",)
+SHARED_TIER_KINDS = ("shared tier surface",)
 MESH_DOCS = ("docs/serving.md",)
 # the pod-scale mesh surface (knob + stats keys) must be named in the
 # "Mesh sharding" doc itself, docs/serving.md — same discipline as the
@@ -60,6 +61,20 @@ DISAGG_NAMES = (
     "num_handoffs", "num_handoff_requests", "num_handoff_bytes",
     "num_affinity_probes_skipped",
     "prefill_handoff",
+)
+# the fleet-global shared prefix tier (budget + scrub-coverage knobs,
+# the publish/dedupe/hit/scrub counters, and the two recorder kinds)
+# must be named in the "Shared prefix tier" doc, docs/fleet.md — each
+# name cross-checked against the live FleetConfig/stats/recorder
+# surfaces so a rename breaks the lint.
+SHARED_TIER_NAMES = (
+    "shared_prefix_bytes", "shared_scrub_blocks",
+    "shared_tier_blocks", "shared_tier_bytes", "shared_tier_hits",
+    "num_shared_publishes", "num_shared_dedupe",
+    "num_shared_evictions", "num_shared_refused",
+    "num_shared_corrupt_discards", "num_shared_scrub_blocks_verified",
+    "num_hash_walks",
+    "shared_publish", "shared_hit",
 )
 # the process-replica surface (mode knob, RPC policy knobs, and the
 # wire-health counters) must be named in the "Process replicas" doc,
@@ -193,6 +208,13 @@ def collect_names():
                 "FleetConfig field, fleet stats() key, or recorder "
                 "event kind — update tools/check_docs.py")
         names.append(("disagg surface", n))
+    for n in SHARED_TIER_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"SHARED_TIER_NAMES lists {n!r}, which is no longer a "
+                "live FleetConfig field, fleet stats() key, or "
+                "recorder event kind — update tools/check_docs.py")
+        names.append(("shared tier surface", n))
     return names
 
 
@@ -213,7 +235,7 @@ def main():
         elif kind in MESH_KINDS:
             text, where = mesh_text, MESH_DOCS
         elif (kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS
-                or kind in DISAGG_KINDS):
+                or kind in DISAGG_KINDS or kind in SHARED_TIER_KINDS):
             text, where = fleet_text, FLEET_DOCS
         else:
             text, where = serving_text, SERVING_DOCS

@@ -155,6 +155,15 @@ def summarize(dump: Dict) -> str:
             f"({sum(int(e.get('bytes', 0)) for e in spills)} bytes), "
             f"{sum(int(e.get('blocks', 0)) for e in uploads)} blocks "
             f"re-admitted by upload across {len(uploads)} admissions")
+    pubs = [e for e in rec_events if e.get("kind") == "shared_publish"]
+    shits = [e for e in rec_events if e.get("kind") == "shared_hit"]
+    if pubs or shits:
+        lines.append(
+            f"-- shared prefix tier: {len(pubs)} publish sweeps storing "
+            f"{sum(int(e.get('blocks', 0)) for e in pubs)} blocks "
+            f"({sum(int(e.get('bytes', 0)) for e in pubs)} bytes), "
+            f"{sum(int(e.get('blocks', 0)) for e in shits)} blocks "
+            f"seeded into replicas across {len(shits)} hits")
     scrubs = [e for e in rec_events if e.get("kind") == "scrub"]
     corrupts = [e for e in rec_events
                 if e.get("kind") == "corruption_detected"]
