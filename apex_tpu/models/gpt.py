@@ -45,6 +45,7 @@ docs/serving.md.
 from __future__ import annotations
 
 import dataclasses
+from typing import Mapping, Optional
 
 import flax.linen as nn
 
@@ -68,6 +69,137 @@ _INIT = nn.initializers.normal(stddev=0.02)
 _COL_PARALLEL = ("attn_q", "attn_k", "attn_v", "mlp_in")
 _ROW_PARALLEL = ("attn_out", "mlp_out")
 
+# the dense modules weight quantization applies to: exactly the six
+# qkv/proj/mlp matmuls the mesh layout shards. Embeddings, layernorms,
+# and the weight-tied LM head stay full precision — they are a small
+# fraction of the bytes and the tied ``wte`` is read by two ops with
+# different contraction axes (no single per-channel scale axis).
+_QUANT_DENSE = _COL_PARALLEL + _ROW_PARALLEL
+
+# weight storage modes (mirrors serving.kv_cache.KV_QUANT_MODES):
+# ``None`` = full precision, ``"int8"`` = symmetric round-to-nearest
+# int8, ``"fp8"`` = float8_e4m3 where the backend has the dtype.
+# Weights are STATIC, so rounding is deterministic round-to-nearest —
+# no position-keyed stochastic rounding like the KV pools need.
+WEIGHT_QUANT_MODES = (None, "int8", "fp8")
+
+
+def fp8_weight_dtype():
+    """The fp8 weight storage dtype, or None when this jax has no
+    fp8 (same probe as ``serving.kv_cache.fp8_kv_dtype``)."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def _weight_quant_dtype(mode):
+    if mode == "int8":
+        return jnp.dtype(jnp.int8)
+    if mode == "fp8":
+        dt = fp8_weight_dtype()
+        if dt is None:
+            raise NotImplementedError(
+                "weight quantization 'fp8' requires a jax with "
+                "jnp.float8_e4m3fn; use 'int8' on this backend")
+        return jnp.dtype(dt)
+    raise ValueError(
+        f"unknown weight quantization {mode!r} "
+        f"(expected one of {WEIGHT_QUANT_MODES})")
+
+
+def _weight_quant_max(mode) -> float:
+    """The quantizer's design max: per-output-channel scales are
+    ``amax / qmax`` so each column's largest magnitude maps onto the
+    representable extreme."""
+    if mode == "int8":
+        return 127.0
+    return float(jnp.finfo(fp8_weight_dtype()).max)
+
+
+def quantize_dense_kernel(kernel, mode):
+    """``(q_kernel, scale)`` for one ``(in, out)`` dense kernel:
+    symmetric per-OUTPUT-channel quantization, deterministic
+    round-to-nearest (weights are static — same values always quantize
+    to the same bytes, which is what lets the process-replica params
+    handshake cover the quantized representation)."""
+    w = jnp.asarray(kernel, jnp.float32)
+    qmax = _weight_quant_max(mode)
+    amax = jnp.max(jnp.abs(w), axis=0)                     # (out,)
+    scale = jnp.where(amax > 0.0, amax / qmax, 1.0).astype(jnp.float32)
+    q = w / scale[None, :]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(_weight_quant_dtype(mode)), scale
+
+
+def quantize_gpt_params(params, mode):
+    """The fp GPT param tree re-expressed in quantized storage: every
+    ``_QUANT_DENSE`` module's ``kernel`` becomes an int8/fp8 array with
+    a per-output-channel fp32 ``scale`` leaf alongside (biases and all
+    other leaves pass through untouched). The result is what a model
+    built with ``GPTConfig(weight_quantization=mode)`` applies —
+    dequantization happens only on the read side, inside the fused
+    dequant-GEMM (:mod:`apex_tpu.ops.dequant_gemm`)."""
+    _weight_quant_dtype(mode)     # validate mode / fp8 availability
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return node
+        out = {}
+        for key, child in node.items():
+            if (key in _QUANT_DENSE and isinstance(child, Mapping)
+                    and "kernel" in child):
+                rec = {k: v for k, v in child.items() if k != "kernel"}
+                q, scale = quantize_dense_kernel(child["kernel"], mode)
+                rec["kernel"] = q
+                rec["scale"] = scale
+                out[key] = rec
+            else:
+                out[key] = walk(child)
+        return out
+
+    return walk(params)
+
+
+def quantize_gpt_model(model, params, mode):
+    """``(quantized_model, quantized_params)`` for a GPT LM and its fp
+    params: the model is rebuilt with ``weight_quantization=mode`` (so
+    its dense modules read quantized storage) and the params are
+    re-expressed via :func:`quantize_gpt_params`. ``mode=None`` is the
+    identity. The serving engine calls this at construction when
+    ``EngineConfig.weight_quantization`` is set."""
+    if mode not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"weight_quantization must be one of {WEIGHT_QUANT_MODES}, "
+            f"got {mode!r}")
+    if mode is None:
+        return model, params
+    cfg = getattr(model, "cfg", None)
+    if not dataclasses.is_dataclass(cfg) or not any(
+            f.name == "weight_quantization"
+            for f in dataclasses.fields(cfg)):
+        raise ValueError(
+            "weight_quantization requires a GPT-family model whose "
+            f"config carries the knob; got {type(model).__name__}")
+    if cfg.weight_quantization is not None:
+        # already quantized storage: idempotent for the same mode
+        # (the params are already the quantized tree — re-quantizing
+        # int8 bytes would corrupt them), a hard error across modes
+        if cfg.weight_quantization == mode:
+            return model, params
+        raise ValueError(
+            f"model already carries weight_quantization="
+            f"{cfg.weight_quantization!r}; cannot re-quantize to "
+            f"{mode!r}")
+    qcfg = dataclasses.replace(cfg, weight_quantization=mode)
+    return type(model)(qcfg), quantize_gpt_params(params, mode)
+
+
+def gpt_param_bytes(params) -> int:
+    """Total device bytes of a param tree — the number the weight-
+    quantization bench arms and the ``dequant_gemm`` recorder event
+    compare between the fp and quantized representations."""
+    return int(sum(x.size * jnp.dtype(x.dtype).itemsize
+                   for x in jax.tree.leaves(params)))
+
 
 def gpt_param_pspec(path, model_axis: str = "model"):
     """:class:`~jax.sharding.PartitionSpec` for one GPT param leaf,
@@ -81,6 +213,15 @@ def gpt_param_pspec(path, model_axis: str = "model"):
       of the KV pools lines up with the projection split;
     - ``attn_out``/``mlp_out`` kernels row-shard (``P(model, None)``),
       biases replicated (they add after the all-reduce);
+    - quantized-weight ``scale`` leaves (per-OUTPUT-channel fp32, one
+      per kernel column — ``weight_quantization``) shard exactly like
+      the bias of their module: ``P(model)`` under column-parallel
+      (the output dim is the sharded one), replicated under
+      row-parallel (the output dim is unsharded there) — the KV-pool
+      colocate-scales-with-bytes rule applied to weights: a kernel
+      shard and the scales that dequantize it always land on the same
+      device, so the fused dequant-GEMM never reaches across the mesh
+      for a scale;
     - ``wte``/``wpe``/layernorms replicate.
     """
     from jax.sharding import PartitionSpec as P
@@ -89,9 +230,17 @@ def gpt_param_pspec(path, model_axis: str = "model"):
     module = names[-2] if len(names) >= 2 else ""
     leaf = names[-1] if names else ""
     if module in _COL_PARALLEL:
-        return P(None, model_axis) if leaf == "kernel" else P(model_axis)
+        if leaf == "kernel":
+            return P(None, model_axis)
+        # bias AND the quantized kernel's per-output-channel "scale":
+        # both are (out,) vectors along the column-sharded output dim
+        return P(model_axis)
     if module in _ROW_PARALLEL:
-        return P(model_axis, None) if leaf == "kernel" else P()
+        if leaf == "kernel":
+            return P(model_axis, None)
+        # bias and "scale" lie along the UNSHARDED output dim here
+        # (they apply after the all-reduce) — replicate
+        return P()
     return P()
 
 
@@ -118,6 +267,13 @@ class GPTConfig:
     moe_layer_freq: int = 1   # every Nth block is MoE (1 = all)
     moe_aux_loss_coeff: float = 0.01
     moe_z_loss_coeff: float = 1e-3
+    # Quantized weight storage (None | "int8" | "fp8"): routes the six
+    # _QUANT_DENSE matmuls through QuantDense, whose params are the
+    # int8/fp8 kernel + per-output-channel fp32 scale that
+    # quantize_gpt_params produces. Normally set via
+    # quantize_gpt_model / EngineConfig.weight_quantization rather
+    # than by hand — the params MUST be the quantized tree.
+    weight_quantization: Optional[str] = None
 
     @staticmethod
     def gpt2_small(**kw):
@@ -133,7 +289,48 @@ class GPTConfig:
         return GPTConfig(**kw)
 
 
+class QuantDense(nn.Module):
+    """Dense layer over quantized weight storage: an int8/fp8
+    ``kernel`` (in, out) plus a per-output-channel fp32 ``scale``
+    (out,) — the leaves :func:`quantize_gpt_params` produces — and an
+    fp32 ``bias``. The forward is the fused dequant-GEMM
+    (:func:`apex_tpu.ops.dequant_gemm.dequant_matmul`): dequantization
+    happens on the read side only, inside the matmul, so the weights
+    never materialize at full precision in HBM.
+
+    Param shapes/dtypes must match the quantized tree exactly (flax
+    validates shapes against these init_fns even in apply mode); the
+    zeros/ones inits only matter for standalone ``init()`` of a
+    quantized-config model, e.g. in eval_shape.
+    """
+
+    features: int
+    mode: str
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from apex_tpu.ops.dequant_gemm import dequant_matmul
+
+        qdt = _weight_quant_dtype(self.mode)
+        kernel = self.param(
+            "kernel", nn.initializers.zeros_init(),
+            (x.shape[-1], self.features), qdt)
+        scale = self.param(
+            "scale", nn.initializers.ones_init(),
+            (self.features,), jnp.float32)
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(),
+            (self.features,), jnp.float32)
+        y = dequant_matmul(x, kernel, scale)
+        return (y + bias).astype(self.dtype)
+
+
 def _dense(cfg, features, name):
+    mode = getattr(cfg, "weight_quantization", None)
+    if mode is not None and name in _QUANT_DENSE:
+        return QuantDense(features, mode=mode, dtype=cfg.dtype,
+                          name=name)
     return nn.Dense(features, dtype=cfg.dtype, param_dtype=jnp.float32,
                     kernel_init=_INIT, name=name)
 

@@ -87,6 +87,13 @@ def flatten_stats(stats: Dict[str, object], sep: str = ".",
 
 # -- the metric surfaces (names enforced documented by check_docs) --------
 
+# numeric encoding of the storage quantization modes for the labeled
+# ``serving_quantization_mode`` gauges (a Prometheus gauge is a float;
+# the mode strings ride the restore fingerprint, the codes ride the
+# dashboard): 0 = full precision, 1 = int8, 2 = fp8
+QUANT_MODE_CODES = {None: 0.0, "int8": 1.0, "fp8": 2.0}
+
+
 def register_engine_metrics(registry: MetricsRegistry) -> Dict[str, object]:
     """Register the serving engine's metric set (idempotent) and return
     the handles. The histograms replace scalar-only EWMAs as the
@@ -118,6 +125,19 @@ def register_engine_metrics(registry: MetricsRegistry) -> Dict[str, object]:
             "requests shed (queue_full + throttled + rejected)"),
         "preemptions": registry.counter(
             "serving_preemptions_total", "lane preemptions"),
+        # one labeled family, one sample per storage surface — the
+        # engine sets both at construction from its config
+        # (QUANT_MODE_CODES), closing the asymmetry where
+        # kv_quantization rode the restore fingerprint but no
+        # observable surface
+        "kv_quant_mode": registry.gauge(
+            "serving_quantization_mode",
+            "storage quantization mode code (0=off, 1=int8, 2=fp8)",
+            labels={"kind": "kv"}),
+        "weight_quant_mode": registry.gauge(
+            "serving_quantization_mode",
+            "storage quantization mode code (0=off, 1=int8, 2=fp8)",
+            labels={"kind": "weight"}),
     }
 
 
@@ -234,6 +254,13 @@ class Observability:
         m = self._m.get(handle)
         if m is not None:
             m.inc(n)
+
+    def gauge(self, handle: str, v: float) -> None:
+        """Set a bound gauge handle (no-op when metrics are off or the
+        handle is unbound)."""
+        m = self._m.get(handle)
+        if m is not None:
+            m.set(v)
 
     # -- the engine-facing event vocabulary --------------------------------
 

@@ -78,6 +78,17 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
+def labeled_name(name: str, labels) -> str:
+    """The Prometheus sample name for (family, labels):
+    ``family{k="v",...}`` with label keys sorted (so one logical
+    metric always produces one registry key), or the bare family name
+    when there are no labels."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
 class Counter:
     """Monotonic counter. ``inc()`` only goes up."""
 
@@ -102,13 +113,22 @@ class Counter:
 
 
 class Gauge:
-    """Point-in-time value. ``set()`` overwrites."""
+    """Point-in-time value. ``set()`` overwrites.
+
+    Optionally labeled: ``labels={"kind": "kv"}`` makes this one
+    sample of the family ``family`` — its registry key and exposed
+    sample name become ``family{kind="kv"}``, and the exposition
+    groups every sample of the family under ONE ``# HELP``/``# TYPE``
+    header (the Prometheus family convention). Unlabeled gauges are
+    byte-identical to the pre-label registry."""
 
     kind = "gauge"
-    __slots__ = ("name", "help", "value")
+    __slots__ = ("name", "help", "value", "family", "labels")
 
-    def __init__(self, name: str, help: str):
-        self.name = name
+    def __init__(self, name: str, help: str, labels=None):
+        self.family = name
+        self.labels = dict(labels) if labels else {}
+        self.name = labeled_name(name, self.labels)
         self.help = help
         self.value = 0.0
 
@@ -226,8 +246,21 @@ class MetricsRegistry:
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get_or_create(Counter, name, help)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "", labels=None) -> Gauge:
+        """Get-or-create keyed by the full sample name, so each label
+        combination of a family is its own gauge (``names()``/
+        ``as_dict()`` list the labeled sample names literally)."""
+        key = labeled_name(name, labels)
+        m = self._metrics.get(key)
+        if m is not None:
+            if not isinstance(m, Gauge):
+                raise ValueError(
+                    f"metric {key!r} already registered as {m.kind}, "
+                    f"requested gauge")
+            return m
+        m = Gauge(name, help, labels=labels)
+        self._metrics[key] = m
+        return m
 
     def histogram(self, name: str, help: str = "",
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
@@ -250,13 +283,27 @@ class MetricsRegistry:
         """Prometheus text format (version 0.0.4): ``# HELP`` /
         ``# TYPE`` headers then the samples, one metric family per
         block, newline-terminated."""
+        names = sorted(self._metrics)
         blocks = []
-        for name in sorted(self._metrics):
+        done = set()
+        for name in names:
             m = self._metrics[name]
+            family = getattr(m, "family", m.name)
+            if family in done:
+                continue
+            done.add(family)
+            # every sample of the family (labeled gauges share one),
+            # in sample-name order, under one HELP/TYPE header —
+            # identical to the pre-label output for unlabeled metrics
+            members = [self._metrics[n] for n in names
+                       if getattr(self._metrics[n], "family",
+                                  self._metrics[n].name) == family]
             lines = []
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            lines.extend(m.expose())
+            help_text = next((x.help for x in members if x.help), "")
+            if help_text:
+                lines.append(f"# HELP {family} {help_text}")
+            lines.append(f"# TYPE {family} {m.kind}")
+            for x in members:
+                lines.extend(x.expose())
             blocks.append("\n".join(lines))
         return "\n".join(blocks) + ("\n" if blocks else "")

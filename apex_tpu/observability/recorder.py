@@ -40,6 +40,7 @@ RECORDER_EVENT_KINDS = (
     "shed",                 # a request shed (queue_full/throttled/rejected)
     "spill",                # an evicted prefix block copied to the host tier
     "spill_upload",         # spilled blocks re-admitted by device upload
+    "dequant_gemm",         # quantized weight storage committed at boot
     "corruption_detected",  # a checksummed artifact failed verification
     "scrub",                # one background integrity pass completed
     "sdc_suspect",          # the fleet cross-check caught a diverging replica

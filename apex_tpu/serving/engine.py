@@ -244,6 +244,11 @@ from apex_tpu.serving.kv_cache import (
     kv_block_bytes,
     seq_block_hashes,
 )
+from apex_tpu.models.gpt import (
+    WEIGHT_QUANT_MODES,
+    gpt_param_bytes,
+    quantize_gpt_model,
+)
 from apex_tpu.serving import mesh as mesh_lib
 from apex_tpu.serving.drafter import NgramDrafter
 from apex_tpu.serving.sampling import (
@@ -449,6 +454,24 @@ class EngineConfig:
     # WITHIN a storage mode. A quantized block charges the tenant
     # ledger its reduced byte footprint (the allocator's block_weight).
     kv_quantization: Optional[str] = None
+    # Quantized WEIGHT storage (docs/serving.md memory tiers): "int8"
+    # or "fp8" re-expresses the GPT qkv/proj/mlp kernels as int8/fp8
+    # with per-output-channel fp32 scales at engine construction
+    # (models/gpt.quantize_gpt_model — deterministic round-to-nearest,
+    # weights are static) and routes those matmuls through the
+    # dequant-GEMM read path (apex_tpu.ops.dequant_gemm; the fused
+    # Pallas kernel opts in via APEX_DEQUANT_GEMM_PALLAS, single-
+    # device meshes only). Quantized logits are tolerance-certified
+    # against the fp path, greedy decode token-identical at the
+    # certified tolerance; within a mode the engine stays fully
+    # deterministic. IDENTITY, not operational: like kv_quantization,
+    # the mode joins the restore fingerprint and the process-replica
+    # params-checksum handshake — snapshots restore across EQUAL
+    # storage modes only, and a replica booted with a mismatched mode
+    # is refused. Composes with kv_quantization (weights) x (KV pool)
+    # and with the model-axis mesh (scale leaves shard with their
+    # kernels — gpt_param_pspec).
+    weight_quantization: Optional[str] = None
     # Host-RAM spill tier for the prefix cache (docs/serving.md):
     # LRU-evicted and ladder-flushed prefix blocks are copied to a
     # bounded host store (this many payload bytes) keyed by their
@@ -628,6 +651,10 @@ class EngineConfig:
             raise ValueError(
                 f"kv_quantization must be one of {KV_QUANT_MODES}, "
                 f"got {self.kv_quantization!r}")
+        if self.weight_quantization not in WEIGHT_QUANT_MODES:
+            raise ValueError(
+                f"weight_quantization must be one of "
+                f"{WEIGHT_QUANT_MODES}, got {self.weight_quantization!r}")
         # normalize (a caller's list restores as the identical
         # fingerprint value) and validate the mesh geometry against the
         # backend, including the batch axis's lane/pool divisibility
@@ -1092,6 +1119,20 @@ class InferenceEngine:
         self.model = model
         self.params = params
         self.config = config
+        # quantized weight storage: re-express the params as int8/fp8
+        # + per-output-channel scales and rebuild the model to read
+        # them through the dequant-GEMM path. Runs FIRST so everything
+        # downstream (sharding, program compilation, checksums) sees
+        # only the quantized representation — the fp tree never
+        # reaches the device when the knob is set.
+        self._weight_quant_bytes = None
+        if config.weight_quantization is not None:
+            fp_bytes = gpt_param_bytes(params)
+            self.model, self.params = quantize_gpt_model(
+                model, params, config.weight_quantization)
+            model, params = self.model, self.params
+            self._weight_quant_bytes = (fp_bytes,
+                                        gpt_param_bytes(self.params))
         # optional chaos harness (apex_tpu.utils.faults.FaultPlan): every
         # jitted dispatch fires the plan at its site ("prefill"/"decode",
         # plus "draft" around the speculative proposer) before
@@ -1138,6 +1179,19 @@ class InferenceEngine:
         self._obs = obs
         if obs is not None:
             obs.bind_engine(self._clock)
+            # both storage quantization modes surface as one labeled
+            # gauge family the moment the engine exists (the modes are
+            # identity, not runtime state — set once, never moved)
+            from apex_tpu.observability import QUANT_MODE_CODES
+            obs.gauge("kv_quant_mode",
+                      QUANT_MODE_CODES[config.kv_quantization])
+            obs.gauge("weight_quant_mode",
+                      QUANT_MODE_CODES[config.weight_quantization])
+            if self._weight_quant_bytes is not None:
+                fp_b, q_b = self._weight_quant_bytes
+                obs.record("dequant_gemm",
+                           mode=config.weight_quantization,
+                           fp_bytes=fp_b, quant_bytes=q_b)
         # (dispatch t0, dispatch seq) of the in-flight decode, tracked
         # only while an observer wants the dispatch->drain trace span
         self._pending_obs = None
@@ -1205,6 +1259,18 @@ class InferenceEngine:
                     f"{tuple(config.mesh_shape)}): the fused paged-read "
                     "kernel is single-device — unset the flag or run "
                     "mesh (1, 1)")
+            from apex_tpu.ops.dequant_gemm import dequant_gemm_wanted
+            if dequant_gemm_wanted():
+                # same single-device story as the paged-read kernel:
+                # pallas_call has no SPMD partitioning rule, and the
+                # XLA dequant chain partitions collective-free with
+                # the scales riding their kernel's shard
+                raise ValueError(
+                    "APEX_DEQUANT_GEMM_PALLAS is incompatible with a "
+                    f"sharded model axis (mesh_shape "
+                    f"{tuple(config.mesh_shape)}): the fused "
+                    "dequant-GEMM kernel is single-device — unset the "
+                    "flag or run mesh (1, 1)")
         # weights and KV pools commit to their mesh layout (head axis
         # over "model"; see gpt.gpt_param_pspec / KVCache.
         # partition_specs), and every jitted program pins its returned
@@ -4063,9 +4129,10 @@ class InferenceEngine:
                      # a re-admitted block is certified token-identical
                      # to recompute, so restoring into a replica with a
                      # different (or no) spill bound changes nothing
-                     # the fingerprint protects. kv_quantization STAYS
-                     # in the fingerprint: quantized outputs are not
-                     # the fp outputs — storage mode IS identity.
+                     # the fingerprint protects. kv_quantization AND
+                     # weight_quantization STAY in the fingerprint:
+                     # quantized outputs are not the fp outputs —
+                     # storage mode IS identity.
                      "spill_max_bytes",
                      "max_waiting", "queue_high_watermark",
                      "free_block_low_watermark", "degrade_patience",
@@ -4572,6 +4639,13 @@ class InferenceEngine:
                              * self.config.mesh_shape[1]),
             "mesh_model_axis": self.config.mesh_shape[1],
             "mesh_batch_axis": self.config.mesh_shape[0],
+            # the storage quantization modes (docs/serving.md memory
+            # tiers): static per config like the mesh keys — equal
+            # configs keep full-stats identity certs byte-comparable —
+            # closing the asymmetry where the modes rode the restore
+            # fingerprint but no observable surface
+            "kv_quantization": self.config.kv_quantization,
+            "weight_quantization": self.config.weight_quantization,
             "num_prefills": self._num_prefills,
             "num_prefill_chunks": self._num_prefill_chunks,
             "num_decode_dispatches": self._num_decode_dispatches,

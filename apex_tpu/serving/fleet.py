@@ -451,7 +451,13 @@ class FleetRouter:
                     "replica_mode='process' with a custom clock needs "
                     "child_clock (e.g. {'kind': 'constant', 't': 0.0})"
                     " — the children cannot inherit a parent lambda")
-            self._params_checksum = params_checksum(params)
+            # covers the representation the replicas will SERVE: with
+            # weight_quantization set, the child quantizes its
+            # spec-rebuilt fp params the same deterministic way before
+            # hashing, so a mode mismatch is refused at hello
+            self._params_checksum = params_checksum(
+                params,
+                weight_quantization=engine_config.weight_quantization)
         else:
             if child_clock is not None:
                 raise ValueError(

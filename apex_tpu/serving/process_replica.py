@@ -220,20 +220,37 @@ def build_model_from_spec(spec: Dict):
     return model, params
 
 
-def params_checksum(params) -> str:
+def params_checksum(params, weight_quantization: Optional[str] = None) -> str:
     """SHA-256 over every weight leaf (path-keyed, order-independent)
     via the house :func:`~apex_tpu.utils.integrity.payload_checksum` —
     the boot-time proof that parent and child hold bit-identical
-    weights."""
+    weights.
+
+    ``weight_quantization`` makes the checksum cover the QUANTIZED
+    representation the engine actually serves: the fp tree is
+    re-expressed via :func:`~apex_tpu.models.gpt.quantize_gpt_params`
+    (deterministic round-to-nearest, so equal fp weights always hash
+    equal) and the mode itself is folded in as an extra leaf — a
+    replica booted with a mismatched mode computes a different
+    checksum from the same spec and is refused at hello, instead of
+    serving different-numerics logits behind an "equal weights"
+    handshake."""
     import jax
     import numpy as np
 
     from apex_tpu.utils.integrity import payload_checksum
 
+    if weight_quantization is not None:
+        from apex_tpu.models.gpt import quantize_gpt_params
+
+        params = quantize_gpt_params(params, weight_quantization)
     leaves, _ = jax.tree_util.tree_flatten_with_path(params)
-    return payload_checksum(
-        {jax.tree_util.keystr(path): np.asarray(leaf)
-         for path, leaf in leaves})
+    payload = {jax.tree_util.keystr(path): np.asarray(leaf)
+               for path, leaf in leaves}
+    if weight_quantization is not None:
+        payload["__weight_quantization__"] = np.frombuffer(
+            weight_quantization.encode("utf-8"), np.uint8)
+    return payload_checksum(payload)
 
 
 def clock_from_spec(spec: Optional[Dict]):

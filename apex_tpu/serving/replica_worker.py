@@ -157,12 +157,18 @@ def _boot(init: Dict):
     model, params = build_model_from_spec(init["model_spec"])
     expect = init.get("params_checksum")
     if expect is not None:
-        got = params_checksum(params)
+        # hash the representation this child will serve: under
+        # weight_quantization the checksum covers the quantized tree
+        # + the mode tag, so a child booted with a mismatched mode
+        # (or a spec that doesn't reproduce the weights) is refused
+        got = params_checksum(
+            params, weight_quantization=config.weight_quantization)
         if got != expect:
             raise IntegrityError(
                 "wire", f"child-rebuilt params checksum {got} != "
                         f"parent's {expect}: the model spec does not "
-                        "reproduce the parent's weights")
+                        "reproduce the parent's weights (or the "
+                        "weight_quantization mode does not match)")
     plan_rec = init.get("faults")
     faults = None if plan_rec is None else plan_from_record(plan_rec)
     clock = clock_from_spec(init.get("clock"))

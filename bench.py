@@ -1043,8 +1043,11 @@ def bench_serving(fast=False):
         "prefix_overlap_90pct": arm90,
         "scheduler_stats": {
             # the sanctioned flattener (docs/observability.md); the
-            # nested per-tenant ledger is excluded — it has its own arm
-            k: (round(v, 4) if isinstance(v, float) else int(v))
+            # nested per-tenant ledger is excluded — it has its own arm;
+            # non-numeric entries (the quantization mode strings/None)
+            # pass through as-is
+            k: (round(v, 4) if isinstance(v, float)
+                else int(v) if isinstance(v, (int, bool)) else v)
             for k, v in _flatten_stats(s90, exclude=("tenants",)).items()
         },
     }
@@ -1957,6 +1960,118 @@ def bench_serving_kv_memory(fast=False):
             "bytes": int(sstats["spill_bytes"]),
             "reserve_token_identical": bool(reserve_identical),
         },
+    }
+
+
+def bench_weight_quant(fast=False):
+    """Weight-quantization arm (round 19, docs/serving.md memory
+    tiers): the capacity + speed story of int8 weight storage with the
+    dequant-GEMM read path, measured the PR 11 way — equal-byte-budget
+    arms.
+
+    Phase 1 (capacity): the model's device param bytes at fp32 vs
+    int8-with-scales storage (``gpt_param_bytes`` over the exact trees
+    the engine serves). Under a FIXED HBM budget the quantized
+    representation serves ``fp_bytes / q_bytes`` x the model bytes per
+    chip — equivalently that many more concurrent model residents
+    (multi-model serving) or a model that many times bigger. ASSERTS
+    the ratio >= 1.8x (the acceptance bar: int8+scale overhead must
+    not eat the 4x dtype win down to marginal).
+
+    Phase 2 (speed + certification): the same seeded greedy trace
+    served by an fp engine and a weight_quantization="int8" engine at
+    equal model/config. Reports decode tokens/s per arm and ASSERTS
+    the outputs are token-identical — the greedy-decode certification
+    of the quantized logits, riding the bench so a numerics regression
+    fails the smoke run, not just tier-1. ``fast=True`` is the tier-1
+    smoke shape."""
+    from apex_tpu.models import GPTConfig, GPTLMHeadModel
+    from apex_tpu.models.gpt import gpt_param_bytes, quantize_gpt_model
+    from apex_tpu.serving import EngineConfig, InferenceEngine, Request
+
+    # FIXED seeds, not _SALT: this arm asserts (token identity), so
+    # the workload must be the workload the asserts were designed
+    # against
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (1, 8))))
+
+    # phase 1: model bytes per chip at an equal HBM budget
+    fp_bytes = gpt_param_bytes(params)
+    _, qparams = quantize_gpt_model(model, params, "int8")
+    q_bytes = gpt_param_bytes(qparams)
+    bytes_ratio = fp_bytes / q_bytes
+    budget = 4 * fp_bytes           # a budget that fits 4 fp residents
+    fp_residents = budget // fp_bytes
+    q_residents = budget // q_bytes
+    assert bytes_ratio >= 1.8, (
+        f"int8 weight storage must serve >= 1.8x the model bytes per "
+        f"chip at an equal HBM budget (got {fp_bytes} fp -> {q_bytes} "
+        f"quantized = {bytes_ratio:.2f}x)")
+
+    # phase 2: decode tok/s fp vs int8 at equal model, token-identity
+    # asserted (greedy + deterministic engine)
+    rr = np.random.RandomState(1)
+    n_req, plen, new = (3, 12, 8) if fast else (6, 16, 16)
+    prompts = [list(rr.randint(0, cfg.vocab_size, plen))
+               for _ in range(n_req)]
+
+    def speed_arm(mode):
+        ecfg = EngineConfig(max_batch=4, block_size=8,
+                            num_blocks=32, max_prefill_len=16,
+                            max_seq_len=48, decode_steps=4,
+                            weight_quantization=mode)
+        eng = InferenceEngine(model, params, ecfg)
+        eng.add_request(Request(uid="warm", prompt=[1] * plen,
+                                max_new_tokens=2))
+        eng.run()               # compile outside the clock
+        for i, p in enumerate(prompts):
+            eng.add_request(Request(uid=f"r{i}", prompt=p,
+                                    max_new_tokens=new))
+        s0 = eng.stats()
+        t0 = time.perf_counter()
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        toks = (eng.stats()["num_tokens_decoded"]
+                - s0["num_tokens_decoded"])
+        return outs, {
+            "decode_tokens_per_sec": round(toks / max(dt, 1e-9), 3),
+            "decode_tokens": int(toks),
+            "wall_s": round(dt, 4),
+        }
+
+    fp_outs, fp_arm = speed_arm(None)
+    q_outs, q_arm = speed_arm("int8")
+    assert q_outs == fp_outs, (
+        "int8 weight storage must decode token-identical to fp on the "
+        "greedy certification trace")
+
+    print(f"# weight-quant: {fp_bytes} fp param bytes -> {q_bytes} "
+          f"int8 = {bytes_ratio:.2f}x model bytes/chip "
+          f"({q_residents} vs {fp_residents} residents at a "
+          f"{budget} B budget) | decode "
+          f"{fp_arm['decode_tokens_per_sec']:.1f} tok/s fp vs "
+          f"{q_arm['decode_tokens_per_sec']:.1f} tok/s int8, "
+          f"token-identical", file=sys.stderr)
+    return {
+        "metric": "serving_tiny_weight_quant_int8_decode_tokens_per_sec",
+        "value": q_arm["decode_tokens_per_sec"],
+        "unit": "tokens/sec",
+        # the capacity headline: model bytes served per chip at an
+        # equal HBM budget, int8 vs fp
+        "vs_baseline": round(bytes_ratio, 3),
+        "bytes_ratio": round(bytes_ratio, 3),
+        "fp_param_bytes": int(fp_bytes),
+        "int8_param_bytes": int(q_bytes),
+        "byte_budget": int(budget),
+        "fp_residents": int(fp_residents),
+        "int8_residents": int(q_residents),
+        "greedy_token_identical": bool(q_outs == fp_outs),
+        "fp": fp_arm,
+        "int8": q_arm,
     }
 
 
@@ -3661,6 +3776,8 @@ def main():
              lambda: bench_serving_multitenant(fast=True)),
             ("bench_serving_kv_memory",
              lambda: bench_serving_kv_memory(fast=True)),
+            ("bench_weight_quant",
+             lambda: bench_weight_quant(fast=True)),
             ("bench_serving_fleet",
              lambda: bench_serving_fleet(fast=True)),
             ("bench_serving_integrity",
@@ -3738,6 +3855,7 @@ def main():
                  bench_serving, bench_serving_multistep,
                  bench_serving_speculative, bench_serving_overload,
                  bench_serving_multitenant, bench_serving_kv_memory,
+                 bench_weight_quant,
                  bench_serving_fleet, bench_serving_integrity,
                  bench_serving_mesh, bench_serving_process,
                  bench_serving_disagg, bench_serving_shared_prefix,

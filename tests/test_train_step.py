@@ -544,6 +544,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "serving_tiny_overload_goodput_tokens_per_sec",
         "serving_tiny_multitenant_victim_goodput_tok_per_sec",
         "serving_tiny_kv_memory_int8_decode_tokens_per_sec",
+        "serving_tiny_weight_quant_int8_decode_tokens_per_sec",
         "serving_tiny_fleet_kill_goodput_tok_per_sec",
         "serving_tiny_integrity_sdc_detection_latency_ticks",
         "serving_tiny_mesh_decode_tokens_per_sec",
@@ -606,6 +607,20 @@ def test_bench_smoke_mode_every_section_rc0():
     assert km["spill"]["blocks_spilled"] > 0, km
     assert km["spill"]["reserve_token_identical"] is True, km
     assert math.isfinite(km["value"]) and km["value"] > 0, km
+    # the weight-quant arm (docs/serving.md "Quantized weight
+    # storage") must prove the capacity headline (>= 1.8x model bytes
+    # per chip at an equal HBM budget) AND the greedy token-identity
+    # cert — a non-asserting arm would be a quiet numerics lie
+    wq = [r for r in records
+          if r.get("metric")
+          == "serving_tiny_weight_quant_int8_decode_tokens_per_sec"][0]
+    assert wq["bytes_ratio"] >= 1.8, wq
+    assert wq["vs_baseline"] == wq["bytes_ratio"], wq
+    assert wq["int8_residents"] > wq["fp_residents"], wq
+    assert wq["int8_param_bytes"] < wq["fp_param_bytes"], wq
+    assert wq["greedy_token_identical"] is True, wq
+    assert wq["int8"]["decode_tokens"] > 0, wq
+    assert math.isfinite(wq["value"]) and wq["value"] > 0, wq
     # the fleet arm (docs/fleet.md) must prove the crash-tolerance
     # headline: a 1-replica fleet bit-identical to the bare engine, a
     # replica killed mid-burst with ZERO lost accepted requests,
@@ -759,6 +774,7 @@ def test_bench_smoke_mode_every_section_rc0():
         "bench_serving", "bench_serving_multistep",
         "bench_serving_speculative", "bench_serving_overload",
         "bench_serving_multitenant", "bench_serving_kv_memory",
+        "bench_weight_quant",
         "bench_serving_fleet", "bench_serving_integrity",
         "bench_serving_mesh", "bench_serving_process",
         "bench_serving_disagg", "bench_serving_shared_prefix",

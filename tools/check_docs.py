@@ -37,6 +37,7 @@ OBS_KINDS = ("trace event type", "recorder event kind", "metric")
 FLEET_KINDS = ("FleetConfig field", "fleet stats() key")
 INTEGRITY_KINDS = ("integrity surface",)
 MESH_KINDS = ("mesh surface",)
+WEIGHT_QUANT_KINDS = ("weight quant surface",)
 PROCESS_KINDS = ("process surface",)
 AUTOSCALE_KINDS = ("autoscale surface",)
 DISAGG_KINDS = ("disagg surface",)
@@ -50,6 +51,14 @@ MESH_DOCS = ("docs/serving.md",)
 MESH_NAMES = (
     "mesh_shape",
     "mesh_devices", "mesh_model_axis", "mesh_batch_axis",
+)
+# the quantized-storage surface (both mode knobs, their stats() keys,
+# and the weight-quantization boot recorder kind) must be named in the
+# quantization coverage of docs/serving.md specifically — each name
+# cross-checked against the live config/stats/recorder surfaces so a
+# rename breaks the lint instead of silently unpinning it.
+WEIGHT_QUANT_NAMES = (
+    "kv_quantization", "weight_quantization", "dequant_gemm",
 )
 # the disaggregated prefill/decode surface (role knob, handoff
 # counters, the two-stage router's probe-skip tally, and the handoff
@@ -185,6 +194,13 @@ def collect_names():
                 "EngineConfig field or stats() key — update "
                 "tools/check_docs.py")
         names.append(("mesh surface", n))
+    for n in WEIGHT_QUANT_NAMES:
+        if n not in live:
+            raise AssertionError(
+                f"WEIGHT_QUANT_NAMES lists {n!r}, which is no longer "
+                "a live EngineConfig field, stats() key, or recorder "
+                "event kind — update tools/check_docs.py")
+        names.append(("weight quant surface", n))
     # the process-replica + autoscaler surfaces: liveness-checked like
     # the integrity surface, routed to docs/fleet.md specifically
     for n in PROCESS_NAMES:
@@ -232,7 +248,7 @@ def main():
             text, where = fleet_text, FLEET_DOCS
         elif kind in INTEGRITY_KINDS:
             text, where = robustness_text, ROBUSTNESS_DOCS
-        elif kind in MESH_KINDS:
+        elif kind in MESH_KINDS or kind in WEIGHT_QUANT_KINDS:
             text, where = mesh_text, MESH_DOCS
         elif (kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS
                 or kind in DISAGG_KINDS or kind in SHARED_TIER_KINDS):

@@ -9,6 +9,7 @@ optimizer) against the 141 TFLOP/s measured matmul ceiling.
 
 Usage:  python tools/profile_step.py [component ...]
         components: attn encoder tail matmul embed opt step
+                    dequant_gemm
         (default: all; `opt` needs a ~10-minute standalone compile)
 """
 
@@ -204,6 +205,50 @@ def prof_matmul():
     return dt
 
 
+def prof_dequant_gemm():
+    """Quantized-weight matmul chain at the encoder shape: the XLA
+    dequant-then-matmul reference vs the fused Pallas dequant-GEMM
+    (apex_tpu.ops.dequant_gemm) vs the fp matmul floor — the decode
+    weight-read path docs/serving.md's weight_quantization knob buys.
+    Same RMS-normalized carry as prof_matmul (defeats the runtime
+    memoizer)."""
+    from apex_tpu.models.gpt import quantize_dense_kernel
+    from apex_tpu.ops import dequant_gemm as dg
+
+    a = jax.random.normal(jax.random.PRNGKey(_SALT), (B * S, H),
+                          jnp.float32)
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (H, I), jnp.float32)
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (I, H), jnp.float32)
+    q1, s1 = quantize_dense_kernel(w1, "int8")
+    q2, s2 = quantize_dense_kernel(w2, "int8")
+    flops = 8 * 2 * 2.0 * B * S * H * I
+    results = {}
+
+    def norm(a):
+        return a * jax.lax.rsqrt(
+            jnp.mean(a.astype(jnp.float32) ** 2) + 1e-6).astype(a.dtype)
+
+    for label, mm in (
+            ("fp32 matmul floor", lambda x, w, q, s: jnp.dot(x, w)),
+            ("XLA dequant chain",
+             lambda x, w, q, s: dg.dequant_matmul_reference(x, q, s)),
+            ("fused dequant-GEMM",
+             lambda x, w, q, s: dg.dequant_matmul(x, q, s,
+                                                  use_pallas=True))):
+
+        @jax.jit
+        def step(a, mm=mm):
+            for _ in range(8):
+                a = norm(mm(mm(a, w1, q1, s1), w2, q2, s2))
+            return (a,)
+
+        dt = _chain(step, (a,), iters=8)
+        results[label] = dt
+        print(f"dequant_gemm {label:<22s} {dt*1e3:7.2f} ms  "
+              f"({flops/dt/1e12:5.1f} TFLOP/s)")
+    return results
+
+
 def prof_step():
     """Full headline step via bench._measure (same session)."""
     sys.path.insert(0, "/root/repo")
@@ -325,7 +370,8 @@ def prof_opt(fraction=1.0):
 
 COMPONENTS = {"attn": prof_attention, "encoder": prof_encoder,
               "tail": prof_tail, "matmul": prof_matmul,
-              "embed": prof_embed, "opt": prof_opt, "step": prof_step}
+              "embed": prof_embed, "opt": prof_opt, "step": prof_step,
+              "dequant_gemm": prof_dequant_gemm}
 
 
 def main():

@@ -155,6 +155,16 @@ def summarize(dump: Dict) -> str:
             f"({sum(int(e.get('bytes', 0)) for e in spills)} bytes), "
             f"{sum(int(e.get('blocks', 0)) for e in uploads)} blocks "
             f"re-admitted by upload across {len(uploads)} admissions")
+    dequants = [e for e in rec_events if e.get("kind") == "dequant_gemm"]
+    if dequants:
+        e = dequants[-1]
+        fp_b = int(e.get("fp_bytes", 0))
+        q_b = int(e.get("quant_bytes", 0))
+        ratio = (fp_b / q_b) if q_b else 0.0
+        lines.append(
+            f"-- weight quantization: mode={e.get('mode')} "
+            f"({fp_b} fp param bytes -> {q_b} quantized, "
+            f"{ratio:.2f}x smaller)")
     pubs = [e for e in rec_events if e.get("kind") == "shared_publish"]
     shits = [e for e in rec_events if e.get("kind") == "shared_hit"]
     if pubs or shits:
