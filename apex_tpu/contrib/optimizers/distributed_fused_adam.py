@@ -41,14 +41,31 @@ with surrounding compute, which is what the reference's
 ``overlap_reductions``/side-stream machinery hand-builds.
 
 Both optimizers follow the functional ``init/step`` contract of
-``apex_tpu.optimizers`` (skip_if = amp overflow no-op, lr override), and
-must be called inside ``shard_map`` with the configured axis in scope.
+``apex_tpu.optimizers`` (skip_if = amp overflow no-op, lr override). Two
+execution modes select how the three collectives are spelled:
+
+- ``flat_mode="collective"`` (default): the explicit ``psum_scatter`` /
+  ``psum`` / ``all_gather`` spelling above — must be called inside
+  ``shard_map`` with ``process_group`` in scope.
+- ``flat_mode="global"``: GLOBAL-math GSPMD spelling for the sharded
+  fused train step (``build_train_step(mesh=...)``). State buffers hold
+  the FULL padded flat stream as a lane-shaped ``(padded/128, 128)``
+  array committed to ``P(process_group, None)`` over ``mesh`` — each
+  rank materializes only its row block, the same 12/dp bytes/param as
+  the collective mode — and ``with_sharding_constraint`` steers the XLA
+  SPMD partitioner to insert the reduce+scatter and gather collectives.
+  Two constraint placements are load-bearing (see
+  ``_global_grad_rows``): gradients replicate BEFORE the flatten, and
+  the flat stream materializes replicated before the ZeRO slice.
+  Without a ``mesh`` the global mode degenerates to a world-of-1 local
+  optimizer (the meshless arm of the (1,1) bit-identity certification).
+  ``predivide_grads`` is ignored: global math is already mean-correct.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,8 +152,31 @@ class _DistributedFlatOptimizer(FusedOptimizer):
     process_group: str = "data"   # mesh axis the optimizer shards over
     group_size: int = 0           # 0 = resolve from parallel_state
     predivide_grads: bool = True  # divide the psum'd grad by dp (DDP mean)
+    flat_mode: str = "collective"  # "collective" (shard_map) | "global"
+    mesh: Any = None              # GSPMD mesh for flat_mode="global"
+
+    def __post_init__(self):
+        if self.flat_mode not in ("collective", "global"):
+            raise ValueError(
+                f"flat_mode must be 'collective' or 'global', "
+                f"got {self.flat_mode!r}")
+        if self.mesh is not None and self.flat_mode != "global":
+            raise ValueError(
+                "mesh= requires flat_mode='global' (the collective mode "
+                "runs inside shard_map and never sees a Mesh object)")
 
     def _world(self) -> int:
+        if self.mesh is not None:
+            return int(self.mesh.shape[self.process_group])
+        if self.flat_mode == "global":
+            # meshless global math has no axis to shard over: a single
+            # world-of-1 "shard" holding the whole padded stream
+            if self.group_size not in (0, 1):
+                raise ValueError(
+                    f"flat_mode='global' without mesh= is the world-of-1 "
+                    f"local optimizer; group_size={self.group_size} needs "
+                    f"a mesh to shard over")
+            return 1
         if self.group_size:
             return self.group_size
         from apex_tpu.transformer import parallel_state
@@ -144,12 +184,125 @@ class _DistributedFlatOptimizer(FusedOptimizer):
         return parallel_state.get_data_parallel_world_size()
 
     def _meta(self, params) -> _FlatMeta:
-        return _FlatMeta(params, self._world())
+        """The flattening metadata, computed ONCE per (world, treedef,
+        leaf-shapes) and cached on the config object — the padding is
+        counted a single time and :meth:`stats` reports it without
+        recomputing (or disagreeing with) what init/step used."""
+        leaves = jax.tree.leaves(params)
+        key = (self._world(), jax.tree.structure(params),
+               tuple((tuple(l.shape), jnp.dtype(l.dtype).name)
+                     for l in leaves))
+        cached = getattr(self, "_meta_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        meta = _FlatMeta(params, self._world())
+        object.__setattr__(self, "_meta_cache", (key, meta))
+        return meta
+
+    def stats(self) -> dict:
+        """Flat-buffer accounting of the LAST init/step geometry —
+        ``flat_pad_elems`` is the ZeRO padding the donation-alias and
+        bench memory records must count as real bytes (the padded tail
+        lives in every master/m/v buffer). Raises before the first
+        ``init``/``step`` call (no geometry has been built yet)."""
+        cached = getattr(self, "_meta_cache", None)
+        if cached is None:
+            raise ValueError(
+                "stats() before init()/step(): the flat-buffer geometry "
+                "is built on first use")
+        meta = cached[1]
+        return {
+            "flat_total_elems": int(meta.total),
+            "flat_padded_elems": int(meta.padded),
+            "flat_pad_elems": int(meta.padded - meta.total),
+            "flat_shard_elems": int(meta.shard),
+            "flat_world": int(meta.world),
+            # fp32 master + exp_avg + exp_avg_sq per shard
+            "opt_state_bytes_per_shard": int(meta.shard) * 4 * 3,
+        }
+
+    # -- GSPMD global-math spelling (flat_mode="global") -----------------
+
+    def _zspec(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh,
+                             PartitionSpec(self.process_group, None))
+
+    def _rep(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _global_grad_rows(self, grads, meta):
+        """The reduce-scatter leg, GSPMD spelling: constrain the grad
+        leaves REPLICATED before the flatten (so the reshape/concat
+        into the flat stream is shard-local — straight into the ZeRO
+        spec the partitioner reshards TP-sharded leaves with an
+        all-to-all and, at combined (B, M) meshes on the XLA vintage we
+        pin, mis-partitions the concat), then materialize the stream
+        replicated and slice to ``P(process_group, None)`` — lowered as
+        the cross-batch reduction + scatter of exactly one flat
+        reduce-scatter (XLA:CPU spells it all-reduce + slice; the
+        ``alt_min_ops`` contract accepts both). No predivide: global
+        math already averages over the global batch."""
+        if self.mesh is not None:
+            grads = jax.tree.map(
+                lambda l: jax.lax.with_sharding_constraint(l, self._rep()),
+                grads)
+        rows = meta.flatten(grads).reshape(meta.padded // LANE, LANE)
+        if self.mesh is not None:
+            rows = jax.lax.with_sharding_constraint(rows, self._rep())
+            rows = jax.lax.with_sharding_constraint(rows, self._zspec())
+        return rows
+
+    def _global_gather_params(self, new_master, meta, params):
+        """The all-gather leg: one replicated materialization of the
+        updated flat stream (cast to ``gather_dtype`` first — the
+        collective moves the smaller payload), then shard-local
+        unflatten; the train step re-constrains the leaves to their
+        tensor-parallel specs (a local slice, no second collective).
+
+        Each unflattened leaf is pinned replicated too: left to
+        propagation, GSPMD pulls the consumer's tensor-parallel spec
+        backward into the 1-D slice and then reshards the reshape with
+        an all-to-all / collective-permute chain per leaf; pinning
+        keeps the slice+reshape shard-local so the only resharding is
+        the free replicated→TP slice downstream."""
+        full = new_master.astype(meta.gather_dtype)
+        if self.mesh is not None:
+            full = jax.lax.with_sharding_constraint(full, self._rep())
+        leaves = meta.unflatten(full.reshape(-1))
+        if self.mesh is not None:
+            leaves = jax.tree.map(
+                lambda l: jax.lax.with_sharding_constraint(l, self._rep()),
+                leaves)
+        return leaves
 
     def init(self, params) -> ShardedOptState:
-        """Build this rank's state shard. Must run inside ``shard_map``
-        with ``process_group`` in scope (uses ``axis_index``)."""
+        """Build the optimizer-state shard. ``flat_mode="collective"``
+        must run inside ``shard_map`` with ``process_group`` in scope
+        (uses ``axis_index``); ``flat_mode="global"`` runs eagerly and
+        commits the full lane-shaped stream sharded over ``mesh``."""
         meta = self._meta(params)
+        if self.flat_mode == "global":
+            host = jax.tree.map(
+                lambda x: jnp.asarray(jax.device_get(x)), params)
+            rows_total = meta.padded // LANE
+            master = meta.flatten(host).reshape(rows_total, LANE)
+            # distinct zero buffers: a donated state must never hold the
+            # same array twice (double-donation raises on XLA:CPU)
+            m = jnp.zeros((rows_total, LANE), jnp.float32)
+            v = jnp.zeros((rows_total, LANE), jnp.float32)
+            step = jnp.zeros((), jnp.int32)
+            if self.mesh is not None:
+                zspec = self._zspec()
+                master = jax.device_put(master, zspec)
+                m = jax.device_put(m, zspec)
+                v = jax.device_put(v, zspec)
+                step = jax.device_put(step, self._rep())
+            return ShardedOptState(step=step, exp_avg=m, exp_avg_sq=v,
+                                   master=master)
         rank = jax.lax.axis_index(self.process_group)
         master = meta.shard_slice(meta.flatten(params), rank)
         zeros = jnp.zeros((meta.rows, LANE), jnp.float32)
@@ -160,6 +313,11 @@ class _DistributedFlatOptimizer(FusedOptimizer):
             master=master,
         )
 
+    def _grad_rows(self, grads, meta):
+        if self.flat_mode == "global":
+            return self._global_grad_rows(grads, meta)
+        return self._reduce_scatter_grads(grads, meta)
+
     def _reduce_scatter_grads(self, grads, meta):
         flat_g = meta.flatten(grads)
         gshard = jax.lax.psum_scatter(
@@ -167,6 +325,11 @@ class _DistributedFlatOptimizer(FusedOptimizer):
         if self.predivide_grads:
             gshard = gshard / meta.world
         return gshard.reshape(meta.rows, LANE)
+
+    def _gather(self, new_master, meta, params):
+        if self.flat_mode == "global":
+            return self._global_gather_params(new_master, meta, params)
+        return self._gather_params(new_master, meta, params)
 
     def _gather_params(self, new_master, meta, params):
         full = jax.lax.all_gather(
@@ -203,7 +366,7 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
         meta = self._meta(params)
         step = state.step + 1
 
-        g = self._reduce_scatter_grads(grads, meta)
+        g = self._grad_rows(grads, meta)
         new_p_l, new_m_l, new_v_l = multi_tensor_adam(
             0, None,
             [[g], [state.master], [state.exp_avg], [state.exp_avg_sq]],
@@ -213,7 +376,7 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
         )
         new_master, m, v = new_p_l[0], new_m_l[0], new_v_l[0]
 
-        new_params = self._gather_params(new_master, meta, params)
+        new_params = self._gather(new_master, meta, params)
         new_state = ShardedOptState(step, m, v, new_master)
         return self._finish(skip_if, new_params, new_state, params, state)
 
@@ -254,16 +417,29 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
         lr = self.lr if lr is None else lr
         meta = self._meta(params)
         step = state.step + 1
-        rank = jax.lax.axis_index(self.process_group)
-        seg = meta.shard_segment_ids(rank)
         nbuckets = meta.num_leaves + 1  # + dummy padding bucket
+        if self.flat_mode == "global":
+            # full-stream segment map: in global math every rank sees
+            # the whole (padded/128, 128) buffer (sharded), so segment
+            # ids cover all of it and no rank index exists
+            pos = jnp.arange(meta.padded, dtype=jnp.int32)
+            seg = jnp.searchsorted(jnp.asarray(meta.offsets), pos,
+                                   side="right").reshape(-1, LANE)
+        else:
+            rank = jax.lax.axis_index(self.process_group)
+            seg = meta.shard_segment_ids(rank)
 
-        g = self._reduce_scatter_grads(grads, meta)
+        g = self._grad_rows(grads, meta)
         p = state.master
 
-        # stage 0: global grad norm (partial on shard, psum completes it)
-        global_norm = jnp.sqrt(
-            jax.lax.psum(jnp.sum(g * g), self.process_group))
+        # stage 0: global grad norm (partial on shard, psum completes
+        # it; in global math the plain sum is already global — the
+        # partitioner inserts the reduction)
+        if self.flat_mode == "global":
+            global_norm = jnp.sqrt(jnp.sum(g * g))
+        else:
+            global_norm = jnp.sqrt(
+                jax.lax.psum(jnp.sum(g * g), self.process_group))
 
         # stage 1: clip + moments + update direction (shared math)
         updates, new_m, new_v = multi_tensor_lamb_stage1(
@@ -280,8 +456,11 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
             w_sq = jnp.zeros((nbuckets,), jnp.float32).at[seg].add(p * p)
             u_sq = jnp.zeros((nbuckets,), jnp.float32).at[seg].add(
                 update * update)
-            w_norm = jnp.sqrt(jax.lax.psum(w_sq, self.process_group))
-            u_norm = jnp.sqrt(jax.lax.psum(u_sq, self.process_group))
+            if self.flat_mode == "global":
+                w_norm, u_norm = jnp.sqrt(w_sq), jnp.sqrt(u_sq)
+            else:
+                w_norm = jnp.sqrt(jax.lax.psum(w_sq, self.process_group))
+                u_norm = jnp.sqrt(jax.lax.psum(u_sq, self.process_group))
             ratio = jnp.where((w_norm > 0) & (u_norm > 0),
                               w_norm / jnp.where(u_norm > 0, u_norm, 1.0),
                               1.0)
@@ -290,6 +469,6 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
             step_scale = jnp.float32(1.0)
         new_master = p - lr * step_scale * update
 
-        new_params = self._gather_params(new_master, meta, params)
+        new_params = self._gather(new_master, meta, params)
         new_state = ShardedOptState(step, m, v, new_master)
         return self._finish(skip_if, new_params, new_state, params, state)

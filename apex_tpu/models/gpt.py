@@ -201,6 +201,29 @@ def gpt_param_bytes(params) -> int:
                    for x in jax.tree.leaves(params)))
 
 
+def gpt_num_layers(params) -> int:
+    """Transformer-block count of a GPT param tree, read off the tree
+    itself (the ``h_{i}`` block subtrees) — lets the sharded-train
+    collective contract (``serving.mesh.train_expected_collectives``)
+    scale its ``2 * num_layers`` tensor-parallel all-reduce floor
+    without threading a :class:`GPTConfig` through the train step.
+    Returns 0 for a non-GPT tree (callers fall back to the layer-count-
+    unknown floor)."""
+    blocks = set()
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return
+        for k, v in tree.items():
+            if (isinstance(k, str) and k.startswith("h_")
+                    and k[2:].isdigit()):
+                blocks.add(k)
+            walk(v) if isinstance(v, dict) else None
+
+    walk(params)
+    return len(blocks)
+
+
 def gpt_param_pspec(path, model_axis: str = "model"):
     """:class:`~jax.sharding.PartitionSpec` for one GPT param leaf,
     keyed by its pytree path (``jax.tree_util.tree_map_with_path``

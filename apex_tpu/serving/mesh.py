@@ -224,3 +224,50 @@ def expected_collectives(mesh_shape) -> dict:
         "alt_min_ops": {"reduce-scatter": 1, "all-gather": 1},
         "forbidden": ("all-to-all",),
     }
+
+
+def train_expected_collectives(mesh_shape, num_layers: Optional[int] = None,
+                               zero: bool = False) -> dict:
+    """The sharded TRAIN-step program-shape contract
+    (``TrainStep.audit_collectives`` feeds this to
+    :func:`apex_tpu.utils.hlo_audit.assert_collective_contract`),
+    per ``(batch, model)`` shape:
+
+    - ``(1, 1)``: exactly ZERO collectives — the bit-identity
+      certification against the meshless fused step leans on a
+      1-device SPMD partition being a no-op, same as serving.
+    - ``batch > 1`` with a ZeRO flat optimizer (``zero=True``): the
+      reduce leg must show the one-reduce-scatter + one-all-gather
+      ZeRO round trip — or the all-reduce + all-gather spelling
+      XLA:CPU lowers the same reduction to (``alt_min_ops``, the
+      round-5 equivalence rule).
+    - ``batch > 1`` without ZeRO: at least the one post-scan gradient
+      all-reduce over the batch axis.
+    - ``model > 1``: the Megatron TP leg — GSPMD all-reduces the two
+      row-parallel projections per block, forward and backward, so the
+      floor is ``2 * num_layers`` all-reduces (1 when the layer count
+      is unknown).
+    - always: NO all-to-all — this layout never reshards an axis, and
+      on the train side an all-to-all is exactly what the flattened
+      ZeRO stream looks like when the partitioner loses the
+      replicate-before-flatten constraint.
+    """
+    shape = validate_mesh_shape(mesh_shape)
+    batch, model = shape
+    if batch == 1 and model == 1:
+        return {"exact_total_ops": 0}
+    min_ops = {}
+    if model > 1:
+        min_ops["all-reduce"] = 2 * num_layers if num_layers else 1
+    if batch > 1 and zero:
+        rs = dict(min_ops)
+        rs["reduce-scatter"] = rs.get("reduce-scatter", 0) + 1
+        rs["all-gather"] = rs.get("all-gather", 0) + 1
+        alt = dict(min_ops)
+        alt["all-reduce"] = alt.get("all-reduce", 0) + 1
+        alt["all-gather"] = alt.get("all-gather", 0) + 1
+        return {"min_ops": rs, "alt_min_ops": alt,
+                "forbidden": ("all-to-all",)}
+    if batch > 1:
+        min_ops["all-reduce"] = min_ops.get("all-reduce", 0) + 1
+    return {"min_ops": min_ops, "forbidden": ("all-to-all",)}

@@ -195,8 +195,13 @@ class TrainLoop:
             dt = obs.now() - t0
             obs.inc("steps")
             obs.observe("step", dt)
-            obs.record("train_step", step=self._steps_dispatched,
-                       host_span_s=dt)
+            mesh_shape = getattr(self._train_step, "mesh_shape", None)
+            if mesh_shape is not None:
+                obs.record("train_step", step=self._steps_dispatched,
+                           host_span_s=dt, mesh=list(mesh_shape))
+            else:
+                obs.record("train_step", step=self._steps_dispatched,
+                           host_span_s=dt)
         if out is not None:
             self._observe(out, raise_on_halt=True)
         self._maybe_checkpoint()
@@ -303,9 +308,15 @@ class TrainLoop:
         sst = self.state.scaler_state
         cur = float(jax.device_get(sst.loss_scale))
         new = max(cur / 2.0, wd.min_scale)
+        fresh = jnp.asarray(new, jnp.float32)
+        # a mesh-sharded state (the GSPMD train step) commits every
+        # leaf; the replacement scalar must land on the same sharding
+        # or the next dispatch retraces on the one uncommitted leaf
+        sharding = getattr(sst.loss_scale, "sharding", None)
+        if getattr(sharding, "mesh", None) is not None:
+            fresh = jax.device_put(fresh, sharding)
         self.state = self.state._replace(
-            scaler_state=sst._replace(
-                loss_scale=jnp.asarray(new, jnp.float32)))
+            scaler_state=sst._replace(loss_scale=fresh))
 
     # -- checkpoint / resume ----------------------------------------------
 

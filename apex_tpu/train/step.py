@@ -108,6 +108,163 @@ def _check_batch(batch, accum_steps: int):
                 f"Reshape [accum*B, ...] data to [accum, B, ...].")
 
 
+def _is_flat_optimizer(optimizer) -> bool:
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        _DistributedFlatOptimizer,
+    )
+
+    return isinstance(optimizer, _DistributedFlatOptimizer)
+
+
+class _GspmdPlan:
+    """The sharded train step's layout plan: one object owning every
+    NamedSharding decision of the GSPMD path (``build_train_step`` with
+    ``mesh=`` and no ``ddp=``) —
+
+    - **params** follow ``pspec_fn(path)`` (default: the Megatron
+      decomposition, :func:`apex_tpu.models.gpt.gpt_param_pspec`) —
+      tensor-parallel activations fall out of GSPMD propagation;
+    - **optimizer state**: a ZeRO flat optimizer's lane-shaped stream
+      shards ``P("batch", None)`` (each rank owns its flat row block);
+      per-leaf moments mirror their parameter's spec (``pspec_fn`` is
+      applied by trailing path, which moment subtrees preserve);
+    - **batch** leaves shard ``batch_spec`` (default ``P(None,
+      "batch")``: accumulation axis unsharded, global batch split over
+      the batch axis — the data-parallel leg, reductions inserted by
+      the partitioner from the global-mean loss);
+    - **scalars** (step counter, scaler state, metrics) replicate.
+
+    The plan is applied twice per object: ``commit_state`` device_puts
+    the initial state (committed inputs = stable jit cache keys), and
+    ``constrain_state`` pins the OUTPUT layouts inside the jitted
+    program — without the output pin GSPMD may hand back a
+    differently-laid-out tree whose next dispatch recompiles, the same
+    one-program contract the serving mesh pins with out_shardings.
+    """
+
+    def __init__(self, mesh, pspec_fn, batch_spec, zero: bool):
+        from jax.sharding import NamedSharding
+
+        self.mesh = mesh
+        self.pspec_fn = pspec_fn
+        self.batch_spec = batch_spec
+        self.zero = zero
+        self.rep = NamedSharding(mesh, _P())
+        self.zspec = self._named(_P("batch", None))
+
+    def _canon(self, spec):
+        """Canonicalize a PartitionSpec the way GSPMD spells output
+        shardings: drop axis names of mesh size 1, then strip trailing
+        ``None`` entries (``P('model', None)`` → ``P('model')``,
+        ``P(None, 'model')`` on a model=1 mesh → ``P()``). Committing
+        inputs with the exact output spelling is what pins the jit
+        cache at one entry — a semantically-equal-but-differently-
+        spelled sharding is a cache MISS, and the second dispatch
+        silently retraces."""
+        shape = dict(self.mesh.shape)
+
+        def live(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if shape.get(a, 1) > 1)
+                return kept if kept else None
+            return entry if shape.get(entry, 1) > 1 else None
+
+        entries = [live(e) for e in tuple(spec)]
+        while entries and entries[-1] is None:
+            entries.pop()
+        return _P(*entries)
+
+    def _named(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self._canon(spec))
+
+    # -- shardings ------------------------------------------------------
+
+    def param_shardings(self, params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: self._named(self.pspec_fn(path)), params)
+
+    def opt_shardings(self, opt_state):
+        if self.zero:
+            # ShardedOptState: scalar step + three lane-shaped streams
+            return type(opt_state)(
+                step=self.rep, exp_avg=self.zspec,
+                exp_avg_sq=self.zspec, master=self.zspec)
+        # per-leaf moments mirror params: the trailing (module, leaf)
+        # path names survive the NamedTuple wrapper, so pspec_fn applies
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: (self._named(self.pspec_fn(path))
+                             if jnp.ndim(x) else self.rep),
+            opt_state)
+
+    def batch_shardings(self, batch):
+        if isinstance(self.batch_spec, _P):
+            specs = jax.tree.map(lambda x: self.batch_spec, batch)
+        else:
+            specs = self.batch_spec
+        axis_sizes = dict(self.mesh.shape)
+
+        def check(x, spec):
+            shape = jnp.shape(x)
+            for dim, names in enumerate(tuple(spec)):
+                if names is None:
+                    continue
+                names = names if isinstance(names, tuple) else (names,)
+                div = 1
+                for n in names:
+                    div *= axis_sizes[n]
+                if dim >= len(shape) or shape[dim] % div:
+                    raise ValueError(
+                        f"mesh axis {names} (size {div}) must divide "
+                        f"batch dim {dim} of leaf shape {shape} — pad "
+                        f"the per-step batch to a multiple of the mesh "
+                        f"batch axis or shrink the mesh")
+            return self._named(spec)
+
+        return jax.tree.map(check, batch, specs)
+
+    # -- placement ------------------------------------------------------
+
+    @staticmethod
+    def _put(x, sharding):
+        return (jax.device_put(x, sharding) if hasattr(x, "ndim")
+                or not isinstance(x, int) else x)
+
+    @staticmethod
+    def _pin(x, sharding):
+        return (jax.lax.with_sharding_constraint(x, sharding)
+                if hasattr(x, "ndim") or not isinstance(x, int) else x)
+
+    def _place_state(self, state: TrainState, put) -> TrainState:
+        rep_tree = lambda tree: jax.tree.map(  # noqa: E731
+            lambda x: put(x, self.rep), tree)
+        return TrainState(
+            step=put(state.step, self.rep),
+            params=jax.tree.map(put, state.params,
+                                self.param_shardings(state.params)),
+            opt_state=jax.tree.map(put, state.opt_state,
+                                   self.opt_shardings(state.opt_state)),
+            scaler_state=rep_tree(state.scaler_state),
+        )
+
+    def commit_state(self, state: TrainState) -> TrainState:
+        return self._place_state(state, self._put)
+
+    def constrain_state(self, state: TrainState) -> TrainState:
+        return self._place_state(state, self._pin)
+
+    def commit_batch(self, batch):
+        return jax.tree.map(jax.device_put, batch,
+                            self.batch_shardings(batch))
+
+    def constrain_metrics(self, metrics):
+        return jax.tree.map(
+            lambda x: self._pin(x, self.rep), metrics)
+
+
 class _StepCore:
     """Shared math of the fused step and the reference loop — ONE
     definition so the certification compares program structure, never
@@ -125,6 +282,15 @@ class _StepCore:
         self.lr_schedule = lr_schedule
         self.with_grad_norm = with_grad_norm
         self.loss_id = loss_id
+        # GSPMD hook (set by TrainStep on the mesh path): constrain the
+        # fp32 grad accumulator to the PARAM pspecs at every boundary —
+        # the scan carry, and the reduced grads entering the optimizer.
+        # Left to propagation, the partitioner gives backward-pass grad
+        # leaves layouts that mismatch the committed moment buffers, and
+        # reconciles each elementwise Adam op with an all-to-all (and
+        # reshards the carry every scan iteration). A no-op when unset
+        # and at a (1, 1) mesh — the bit-identity certifications hold.
+        self.acc_constraint = None
         if self.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
@@ -156,6 +322,8 @@ class _StepCore:
     def zero_carry(self, params):
         acc = jax.tree.map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
                            params)
+        if self.acc_constraint is not None:
+            acc = self.acc_constraint(acc)
         return acc, jnp.zeros((), jnp.float32), jnp.zeros((), bool)
 
     # -- post-accumulation tail (identical in fused and reference) -------
@@ -166,8 +334,10 @@ class _StepCore:
         if self.ddp is not None:
             return self.ddp.allreduce_accumulated(acc, self.accum_steps)
         if self.accum_steps > 1:
-            return jax.tree.map(
+            acc = jax.tree.map(
                 lambda a: a / jnp.asarray(self.accum_steps, a.dtype), acc)
+        if self.acc_constraint is not None:
+            acc = self.acc_constraint(acc)
         return acc
 
     def apply(self, state: TrainState, acc, loss_sum, inf_any, aux=None):
@@ -247,6 +417,9 @@ class _StepCore:
 
         def body(carry, mb):
             new_carry, aux = self.microbatch(params, sst, carry, mb)
+            if self.acc_constraint is not None:
+                acc_c, loss_c, inf_c = new_carry
+                new_carry = (self.acc_constraint(acc_c), loss_c, inf_c)
             # Pin the reference loop's DISPATCH boundary: each hand-wired
             # microbatch ends a program, so nothing there cross-fuses the
             # backward into the next phase's arithmetic. When this scan
@@ -275,16 +448,51 @@ class TrainStep:
     ``donate=True`` (default): the passed-in state is consumed.
     """
 
-    def __init__(self, core: _StepCore, donate: bool, mesh, batch_spec):
+    def __init__(self, core: _StepCore, donate: bool, mesh, batch_spec,
+                 param_pspec=None, num_heads: Optional[int] = None):
         self._core = core
         self.donate = donate
         self.accum_steps = core.accum_steps
+        self._plan: Optional[_GspmdPlan] = None
+        self.mesh_shape: Optional[tuple] = None
         fn = core.fused_step
-        if mesh is not None:
-            if core.ddp is None:
+        if mesh is not None and core.ddp is None:
+            # GSPMD single-dispatch path: ZeRO + tensor parallel via
+            # sharding annotation on the serving mesh, no shard_map
+            from apex_tpu.serving.mesh import MESH_AXES, validate_mesh_shape
+
+            if tuple(mesh.axis_names) != MESH_AXES:
                 raise ValueError(
-                    "mesh= without ddp=: pass the DistributedDataParallel "
-                    "config whose axis_name matches the mesh axis")
+                    f"mesh= without ddp= is the GSPMD train path and "
+                    f"needs the serving mesh axes {MESH_AXES} "
+                    f"(serving.mesh.build_mesh); got {mesh.axis_names}")
+            shape = (int(mesh.shape["batch"]), int(mesh.shape["model"]))
+            validate_mesh_shape(shape, num_heads=num_heads, knob="mesh")
+            zero = _is_flat_optimizer(core.optimizer)
+            if zero and core.optimizer.group_size not in (0, shape[0]):
+                raise ValueError(
+                    f"the flat optimizer's group_size "
+                    f"({core.optimizer.group_size}) must be 0 or the "
+                    f"mesh batch axis ({shape[0]}): the ZeRO shard "
+                    f"count IS the batch axis on the GSPMD path")
+            if param_pspec is None:
+                from apex_tpu.models.gpt import gpt_param_pspec
+                param_pspec = gpt_param_pspec
+            self.mesh_shape = shape
+            self._mesh = mesh
+            self._plan = plan = _GspmdPlan(
+                mesh, param_pspec,
+                batch_spec if batch_spec is not None else _P(None, "batch"),
+                zero=zero)
+            core.acc_constraint = lambda acc: jax.tree.map(
+                plan._pin, acc, plan.param_shardings(acc))
+
+            def fn(state, batch):
+                new_state, metrics = core.fused_step(state, batch)
+                return (plan.constrain_state(new_state),
+                        plan.constrain_metrics(metrics))
+        elif mesh is not None:
+            # legacy 1-D shard_map path (ddp's axis over mesh)
             if batch_spec is None:
                 batch_spec = _P(None, core.ddp.axis_name)
             fn = compat_shard_map(
@@ -298,17 +506,30 @@ class TrainStep:
     def init(self, params, scaler_state: Optional[ScalerState] = None
              ) -> TrainState:
         """Fresh :class:`TrainState` (step 0, zero moments, scaler at its
-        initial scale — or carry in a checkpointed ``scaler_state``)."""
-        return TrainState(
+        initial scale — or carry in a checkpointed ``scaler_state``).
+        On the GSPMD path the params are committed to their mesh layout
+        first and the whole state comes back committed (stable jit
+        cache keys; pass uncommitted host params freely)."""
+        if self._plan is not None:
+            from apex_tpu.serving.mesh import shard_params
+
+            params = shard_params(self._mesh, params,
+                                  pspec_fn=self._plan.pspec_fn)
+        state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=self._core.optimizer.init(params),
             scaler_state=(self._core.scaler.init() if scaler_state is None
                           else scaler_state),
         )
+        if self._plan is not None:
+            state = self._plan.commit_state(state)
+        return state
 
     def step(self, state: TrainState, batch):
         _check_batch(batch, self.accum_steps)
+        if self._plan is not None:
+            batch = self._plan.commit_batch(batch)
         return self._jitted(state, batch)
 
     __call__ = step
@@ -332,6 +553,78 @@ class TrainStep:
         _check_batch(batch, self.accum_steps)
         return lowered_alias_stats(self._jitted, state, batch)
 
+    def audit_collectives(self, state: TrainState, batch,
+                          num_layers: Optional[int] = None) -> dict:
+        """Certify the sharded step's compiled program against the
+        per-mesh collective contract — the serving mesh's audit applied
+        to training. AOT-lowers from abstract sharded ShapeDtypeStructs
+        (no dispatch, no donated-buffer consumption, jit cache
+        untouched) and asserts:
+
+        - :func:`apex_tpu.serving.mesh.train_expected_collectives` for
+          this mesh shape — zero collectives at (1, 1); the one
+          reduce-scatter + all-gather ZeRO round trip (or XLA:CPU's
+          all-reduce spelling, ``alt_min_ops``) when the batch axis
+          shards a flat optimizer; ``>= 2 * num_layers`` all-reduces on
+          the tensor-parallel leg; never an all-to-all;
+        - donation alias pairs ``>=`` the sharded param + optimizer
+          leaf count (XLA drops donation silently; the positive count
+          is the certification signal).
+
+        ``num_layers`` defaults to reading the GPT block count off
+        ``state.params`` (:func:`~apex_tpu.models.gpt.gpt_num_layers`);
+        pass it explicitly for non-GPT trees. Returns
+        ``{"collectives", "alias", "contract", "sharded_leaves"}``.
+        Raises ``AssertionError`` on any violation; requires the GSPMD
+        ``mesh=`` path."""
+        from apex_tpu.serving.mesh import train_expected_collectives
+        from apex_tpu.utils.hlo_audit import (
+            abstract_sharded,
+            assert_collective_contract,
+            collective_stats,
+            input_output_alias_stats,
+        )
+
+        if self._plan is None:
+            raise ValueError(
+                "audit_collectives requires the GSPMD train step "
+                "(build_train_step(mesh=...) without ddp=)")
+        _check_batch(batch, self.accum_steps)
+        specs = self._plan.batch_shardings(batch)
+        abatch = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(
+                jnp.shape(x), getattr(x, "dtype", jnp.asarray(x).dtype),
+                sharding=s),
+            batch, specs)
+        txt = (self._jitted.lower(abstract_sharded(state), abatch)
+               .compile().as_text())
+        # exclude_degenerate: CSE-merged scalar-constant broadcasts
+        # resharded across mixed-layout leaves lower as all-to-alls
+        # of a constant — no data moves; counting them would fail
+        # the no-all-to-all contract on an artifact
+        stats = collective_stats(txt, exclude_degenerate=True)
+        if num_layers is None:
+            from apex_tpu.models.gpt import gpt_num_layers
+
+            num_layers = gpt_num_layers(state.params) or None
+        contract = train_expected_collectives(
+            self.mesh_shape, num_layers=num_layers, zero=self._plan.zero)
+        label = f"train_step@mesh{self.mesh_shape}"
+        assert_collective_contract(stats, label=label, **contract)
+        alias = input_output_alias_stats(txt)
+        sharded_leaves = sum(
+            1 for leaf in jax.tree.leaves((state.params, state.opt_state))
+            if hasattr(leaf, "ndim"))
+        if self.donate and alias["pairs"] < sharded_leaves:
+            raise AssertionError(
+                f"{label}: XLA honored {alias['pairs']} donation alias "
+                f"pair(s) but the state carries {sharded_leaves} sharded "
+                f"param + optimizer leaves — donation was dropped "
+                f"(layout/dtype mismatch between a donated input and "
+                f"its output)")
+        return {"collectives": stats, "alias": alias,
+                "contract": contract, "sharded_leaves": sharded_leaves}
+
     def loop(self, state: TrainState, **kwargs):
         """A deferred-metrics :class:`apex_tpu.train.TrainLoop` over this
         step, starting from ``state``; keyword arguments (fault plan,
@@ -353,6 +646,8 @@ def build_train_step(
     donate: bool = True,
     mesh=None,
     batch_spec=None,
+    param_pspec=None,
+    num_heads: Optional[int] = None,
     loss_id: int = 0,
 ) -> TrainStep:
     """Compile forward + backward + unscale/overflow-skip + accumulation
@@ -377,17 +672,53 @@ def build_train_step(
       with_grad_norm: include the post-reduction global grad norm in the
         metrics (one extra fused reduction pass).
       donate: donate the :class:`TrainState` (in-place aliased updates).
-      mesh / batch_spec: when ``ddp`` is given, wrap the program in
-        ``shard_map`` over ``mesh``; ``batch_spec`` defaults to
-        ``P(None, ddp.axis_name)`` (accumulation axis unsharded, batch
-        axis data-parallel). Without ``mesh`` the caller may shard_map
-        the returned step themselves.
+      mesh / batch_spec: with ``ddp``, wrap the program in ``shard_map``
+        over ``mesh`` (the legacy 1-D data-parallel path; ``batch_spec``
+        defaults to ``P(None, ddp.axis_name)``). WITHOUT ``ddp``, a
+        ``mesh`` selects the GSPMD single-dispatch path: the serving
+        ``("batch", "model")`` mesh (``serving.mesh.build_mesh``), with
+        tensor-parallel params via ``param_pspec``, the global batch
+        sharded ``P(None, "batch")``, and — when ``optimizer`` is a
+        ``DistributedFused*`` flat optimizer — ZeRO state sharded over
+        the batch axis, all inside ONE donated dispatch whose contract
+        :meth:`TrainStep.audit_collectives` certifies. Mesh geometry is
+        validated here, at construction, with named-knob errors.
+        Without ``mesh`` the caller may shard_map the returned step
+        themselves (via :attr:`TrainStep.program`).
+      param_pspec: GSPMD path only — ``pspec_fn(path) -> PartitionSpec``
+        for each param leaf (default
+        :func:`apex_tpu.models.gpt.gpt_param_pspec`); also applied (by
+        trailing path) to mirrored per-leaf optimizer moments.
+      num_heads: GSPMD path only — when given, the mesh ``model`` axis
+        must divide it (construction-time check; the trace would
+        otherwise fail deep inside attention).
     """
+    sharded = mesh is not None and ddp is None
+    if _is_flat_optimizer(optimizer):
+        if sharded:
+            bsize = dict(mesh.shape).get("batch")
+            if bsize is not None and optimizer.group_size not in (
+                    0, int(bsize)):
+                raise ValueError(
+                    f"the flat optimizer's group_size "
+                    f"({optimizer.group_size}) must be 0 or the mesh "
+                    f"batch axis ({int(bsize)}): the ZeRO shard count "
+                    f"IS the batch axis on the GSPMD path")
+            optimizer = optimizer.replace(
+                flat_mode="global", mesh=mesh,
+                process_group="batch",
+                group_size=int(bsize) if bsize else 0)
+        elif mesh is None and optimizer.mesh is not None:
+            raise ValueError(
+                "the flat optimizer carries a mesh but build_train_step "
+                "got mesh=None; pass the same mesh (or a fresh "
+                "unconfigured optimizer)")
     scaler, trace_wrapper = _resolve_scaler(amp, loss_id)
     core = _StepCore(loss_fn, optimizer, scaler, trace_wrapper, ddp,
                      accum_steps, has_aux, lr_schedule, with_grad_norm,
                      loss_id)
-    return TrainStep(core, donate, mesh, batch_spec)
+    return TrainStep(core, donate, mesh, batch_spec,
+                     param_pspec=param_pspec, num_heads=num_heads)
 
 
 class ReferenceLoop:

@@ -50,24 +50,59 @@ def _marker_path(directory: str, step: int) -> str:
     return checkpoint_path(directory, step) + ".complete"
 
 
-def _write_marker(directory: str, step: int, names) -> None:
+def _write_marker(directory: str, step: int, names,
+                  fingerprint: Optional[dict] = None) -> None:
     """The terminal write of a save: a small JSON manifest (step + tree
-    names), written to a temp file and atomically renamed into place so
-    the marker itself can never be observed torn."""
+    names + optional topology fingerprint), written to a temp file and
+    atomically renamed into place so the marker itself can never be
+    observed torn."""
     marker = _marker_path(directory, step)
     tmp = marker + ".tmp"
+    manifest = {"step": int(step), "trees": sorted(names)}
+    if fingerprint:
+        manifest["fingerprint"] = fingerprint
     with open(tmp, "w") as f:
-        json.dump({"step": int(step), "trees": sorted(names)}, f)
+        json.dump(manifest, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, marker)
 
 
-def save_checkpoint(directory: str, step: int, **trees) -> str:
+def read_marker(directory: str, step: int) -> Optional[dict]:
+    """The step's commit-marker manifest as a dict, or None when the
+    marker does not exist (torn save, or a legacy pre-marker
+    directory). Legacy markers lack the ``"fingerprint"`` key."""
+    marker = _marker_path(directory, step)
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        return json.load(f)
+
+
+def state_mesh_shape(state) -> Optional[list]:
+    """The mesh fingerprint of a pytree: ``[[axis, size], ...]`` from
+    the first leaf whose sharding is a mesh-backed ``NamedSharding``,
+    or None for a meshless (single-device / host) tree. JSON-shaped
+    (lists, not tuples) so it round-trips through the marker manifest
+    unchanged — equality against a freshly computed fingerprint is the
+    resume-compatibility check."""
+    for leaf in jax.tree.leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            return [[str(axis), int(size)] for axis, size in shape.items()]
+    return None
+
+
+def save_checkpoint(directory: str, step: int,
+                    fingerprint: Optional[dict] = None, **trees) -> str:
     """Save named pytrees (params=..., opt_state=..., scaler_state=...)
     as one checkpoint under ``directory/step_NNNNNNNNN``. Returns the
     path. Overwrites an existing checkpoint at the same step (resume
-    after preemption re-saves the same step).
+    after preemption re-saves the same step). ``fingerprint`` (a small
+    JSON-able dict, e.g. ``{"mesh_shape": state_mesh_shape(state)}``)
+    rides in the commit marker for load-time topology checks.
 
     **Crash-safe**: the payload write is finalized by an atomic
     manifest/marker write (``step_NNNNNNNNN.complete``), and
@@ -93,7 +128,7 @@ def save_checkpoint(directory: str, step: int, **trees) -> str:
     payload = {k: v for k, v in trees.items() if v is not None}
     payload["_step"] = step
     _checkpointer().save(path, payload, force=True)
-    _write_marker(directory, step, payload.keys())
+    _write_marker(directory, step, payload.keys(), fingerprint=fingerprint)
     return path
 
 
@@ -182,12 +217,20 @@ def save_train_state(directory: str, state) -> str:
     state's device buffers are consumed by the next dispatch, so the
     checkpoint must own its memory — and the copy doubles as the sync
     point guaranteeing every dispatched step reflected in ``state``
-    has actually executed. Returns the checkpoint path."""
+    has actually executed. A mesh-sharded state (the GSPMD train step)
+    lands as plain host-replicated arrays — the payload is
+    topology-free — but its mesh shape joins the commit-marker
+    fingerprint so :func:`load_train_state` can refuse a mismatched
+    mesh instead of silently resharding. Returns the checkpoint
+    path."""
     import numpy as np
 
+    mesh_shape = state_mesh_shape(state)
     host = jax.device_get(state)
     step = int(np.asarray(host.step))
-    return save_checkpoint(directory, step, train_state=host)
+    return save_checkpoint(
+        directory, step, train_state=host,
+        fingerprint={"mesh_shape": mesh_shape} if mesh_shape else None)
 
 
 def load_train_state(directory: str, template_state,
@@ -197,12 +240,46 @@ def load_train_state(directory: str, template_state,
     structure — a fresh ``TrainStep.init(params)`` result works (its
     values are never read, only its containers/dtypes/shapes). Leaves
     come back as device arrays; resuming a loop from the result is
-    bit-identical to the uninterrupted run (tests/test_faults.py)."""
+    bit-identical to the uninterrupted run (tests/test_faults.py).
+
+    **Mesh fingerprint**: when both the checkpoint's commit marker and
+    ``template_state`` carry a mesh shape and they differ, the load is
+    REFUSED (``ValueError`` naming both shapes) — a (2, 1) shard set
+    silently resharded onto a (1, 2) mesh would resume without error
+    and train a subtly different program; cross-topology moves must go
+    through a meshless template explicitly. Legacy checkpoints (no
+    fingerprint in the marker) and meshless templates skip the check.
+    Restored leaves are committed onto the template's shardings, so a
+    resumed sharded step re-dispatches the already-compiled program
+    instead of retracing."""
     import jax.numpy as jnp
 
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    marker = read_marker(os.path.abspath(os.fspath(directory)), step)
+    saved_mesh = (marker or {}).get("fingerprint", {}).get("mesh_shape")
+    want_mesh = state_mesh_shape(template_state)
+    if saved_mesh is not None and want_mesh is not None \
+            and saved_mesh != want_mesh:
+        raise ValueError(
+            f"checkpoint step {step} under {directory!r} was saved from "
+            f"a mesh of shape {saved_mesh} but the template state is "
+            f"sharded over {want_mesh} — refusing to reshard on resume "
+            f"(knob: mesh; load into a meshless template and re-shard "
+            f"explicitly to move topologies)")
     restored = load_checkpoint(directory, step=step,
                                template=dict(train_state=template_state))
-    state = jax.tree.map(jnp.asarray, restored["train_state"])
+
+    def _place(x, t):
+        x = jnp.asarray(x)
+        sharding = getattr(t, "sharding", None)
+        if getattr(sharding, "mesh", None) is not None:
+            x = jax.device_put(x, sharding)
+        return x
+
+    state = jax.tree.map(_place, restored["train_state"], template_state)
     return state, int(restored["_step"])
 
 

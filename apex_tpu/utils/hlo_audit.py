@@ -45,7 +45,13 @@ COLLECTIVE_KINDS = (
 _LINE_RE = re.compile(
     r"=\s*(?P<shapes>.*?)\s+(?P<kind>"
     + "|".join(COLLECTIVE_KINDS)
-    + r")(?:-start)?\(")
+    + r")(?:-start)?\((?P<operands>[^)]*)")
+
+# an instruction defined as broadcast of a SCALAR (empty dims `[]`) —
+# its value is sharding-invariant by construction, so any collective
+# whose operands are all such broadcasts moves no information
+_SCALAR_BCAST_RE = re.compile(
+    r"%(?P<name>[\w.\-]+)\s*=\s*\S+\s*broadcast\(\s*[a-z][a-z0-9]*\[\]")
 
 
 def _dtype_bytes(dt: str) -> int:
@@ -75,24 +81,72 @@ def _shape_bytes(shapes_text: str) -> int:
     return total
 
 
-def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
+def collective_stats(hlo_text: str,
+                     exclude_degenerate: bool = False,
+                     ) -> Dict[str, Dict[str, int]]:
     """Per-kind ``{"ops": count, "bytes": output_bytes}`` for every
     collective in ``hlo_text``, plus a ``"total"`` row. Async pairs
     are counted once (the ``-done`` line repeats no shapes and does
-    not match)."""
+    not match).
+
+    ``exclude_degenerate=True`` drops collectives whose every operand
+    is a broadcast of a scalar, tallying them under a separate
+    ``"degenerate"`` row instead of their kind (and outside the
+    total). XLA's CSE merges the scalar-constant broadcasts (optimizer
+    betas, ``1/accum`` divisors, zero fills) shared by same-shape
+    leaves committed to DIFFERENT layouts, then "reshards" the merged
+    broadcast with a collective — an all-to-all of a constant that
+    moves no model or optimizer data. The sharded train step's
+    contract forbids all-to-all of real data; these artifacts would be
+    false positives. Default ``False`` keeps the raw count (the
+    serving audits' historical accounting)."""
     stats = {k: {"ops": 0, "bytes": 0} for k in COLLECTIVE_KINDS}
+    scalar_bcasts = (
+        {m.group("name") for m in _SCALAR_BCAST_RE.finditer(hlo_text)}
+        if exclude_degenerate else set())
+    degenerate = {"ops": 0, "bytes": 0}
     for line in hlo_text.splitlines():
         m = _LINE_RE.search(line)
         if not m:
             continue
         kind = m.group("kind")
+        nbytes = _shape_bytes(m.group("shapes"))
+        if exclude_degenerate:
+            operands = re.findall(r"%([\w.\-]+)", m.group("operands"))
+            if operands and all(op in scalar_bcasts for op in operands):
+                degenerate["ops"] += 1
+                degenerate["bytes"] += nbytes
+                continue
         stats[kind]["ops"] += 1
-        stats[kind]["bytes"] += _shape_bytes(m.group("shapes"))
+        stats[kind]["bytes"] += nbytes
     stats["total"] = {
         "ops": sum(s["ops"] for s in stats.values()),
         "bytes": sum(s["bytes"] for s in stats.values()),
     }
+    if exclude_degenerate:
+        stats["degenerate"] = degenerate
     return stats
+
+
+def abstract_sharded(tree):
+    """Mirror a pytree of (possibly committed, possibly donated) arrays
+    as ``jax.ShapeDtypeStruct`` leaves carrying each array's sharding —
+    the input for ``jitted.lower(...)`` audits. Lowering from abstract
+    sharded structs compiles the exact per-mesh program WITHOUT
+    dispatching it or consuming donated buffers, and leaves the jit
+    call cache untouched (the serving engine's AOT audit pattern,
+    generalized). Non-array leaves (plain ints in NamedTuple slots)
+    pass through unchanged."""
+    import jax
+
+    def one(x):
+        if hasattr(x, "ndim") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(
+                tuple(x.shape), x.dtype,
+                sharding=getattr(x, "sharding", None))
+        return x
+
+    return jax.tree.map(one, tree)
 
 
 def lowered_collective_stats(jitted, *args, **kwargs):

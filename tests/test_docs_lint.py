@@ -174,3 +174,39 @@ def test_observability_names_are_checked_against_their_doc():
     obs_text = mod._docs_text(mod.OBS_DOCS)
     assert "spec_tokens" in serving_text
     assert "spec_tokens" not in obs_text
+
+
+def test_lint_detects_phantom_train_sharded_names(monkeypatch):
+    """The sharded-train surface is checked against docs/training.md
+    specifically: a phantom GSPMD knob/stat must be flagged."""
+    mod = _load_check_docs()
+    orig = mod.collect_names
+    phantom = ("train sharded surface", "phantom_zero_shard_stat")
+
+    def with_phantom():
+        return orig() + [phantom]
+
+    monkeypatch.setattr(mod, "collect_names", with_phantom)
+    missing = mod.main()
+    assert phantom in missing
+
+
+def test_train_sharded_names_are_live_surfaces():
+    """TRAIN_SHARDED_NAMES cross-checks itself against the live
+    build_train_step signature / optimizer stats / TrainStep surfaces:
+    naming a nonexistent knob raises, so a rename cannot silently
+    unpin the docs/training.md routing."""
+    mod = _load_check_docs()
+    names = mod.collect_names()
+    train = {n for k, n in names if k == "train sharded surface"}
+    assert train == set(mod.TRAIN_SHARDED_NAMES)
+
+
+def test_train_sharded_names_are_checked_against_training_doc():
+    """The sharded-train kinds map to docs/training.md alone — every
+    TRAIN_SHARDED_NAMES entry must appear there (the "Sharded
+    training" section)."""
+    mod = _load_check_docs()
+    train_text = mod._docs_text(mod.TRAIN_DOCS)
+    for name in mod.TRAIN_SHARDED_NAMES:
+        assert name in train_text, name

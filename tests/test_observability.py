@@ -557,6 +557,26 @@ def test_trainloop_observability_watchdog_and_metrics():
     assert "observability" not in loop.stats()
 
 
+def test_trainloop_mesh_rides_records_and_summary():
+    """A sharded train step (one exposing ``mesh_shape``) stamps the
+    mesh into every ``train_step`` recorder event, and the offline
+    summarizer renders the sharded-train line from the dump."""
+    obs = Observability()
+
+    def fake_step(state, batch):
+        return state, {"loss": 1.0}
+
+    fake_step.mesh_shape = (2, 1)
+    loop = TrainLoop(fake_step, _FakeState(), obs=obs)
+    loop.run(range(3))
+    evs = [e for e in obs.recorder.tail() if e["kind"] == "train_step"]
+    assert len(evs) == 3
+    assert all(e["mesh"] == [2, 1] for e in evs)
+    report = _load_trace_summary().summarize(obs.dump())
+    assert "-- sharded train: 3/3 steps" in report
+    assert "(batch, model)=(2x1) mesh" in report
+
+
 def test_trainloop_without_obs_unchanged():
     losses = iter(float(i) for i in range(6))
 

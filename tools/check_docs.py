@@ -106,6 +106,21 @@ AUTOSCALE_NAMES = (
 # somewhere in the combined serving text. Each name listed here is
 # additionally cross-checked against the live config/stats surfaces,
 # so a renamed knob breaks the lint instead of silently unpinning it.
+# the sharded-train surface (round 20): the GSPMD knobs on
+# build_train_step, the ZeRO flat-buffer knobs + stats() accounting
+# keys of DistributedFusedAdam, and the TrainStep audit surface must
+# be named in the "Sharded training" doc, docs/training.md — each
+# name cross-checked against the live signature/field/stats surfaces
+# so a renamed knob breaks the lint instead of silently unpinning it.
+TRAIN_DOCS = ("docs/training.md",)
+TRAIN_SHARDED_KINDS = ("train sharded surface",)
+TRAIN_SHARDED_NAMES = (
+    "mesh", "batch_spec", "param_pspec", "num_heads",
+    "flat_mode", "group_size",
+    "flat_pad_elems", "flat_shard_elems", "flat_world",
+    "opt_state_bytes_per_shard",
+    "audit_collectives", "mesh_shape",
+)
 INTEGRITY_NAMES = (
     "verify_artifacts", "scrub_interval_ticks", "scrub_spill_blocks",
     "sdc_check_interval_ticks",
@@ -231,6 +246,32 @@ def collect_names():
                 "live FleetConfig field, fleet stats() key, or "
                 "recorder event kind — update tools/check_docs.py")
         names.append(("shared tier surface", n))
+    # the sharded-train surface: liveness from the build_train_step
+    # signature, the DistributedFusedAdam dataclass fields, a live
+    # world-1 optimizer's stats() keys (the flat geometry is built on
+    # first init), and a constructed meshless TrainStep's attributes +
+    # public methods — routed to docs/training.md specifically
+    import inspect
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.train import build_train_step
+
+    opt = DistributedFusedAdam(lr=1e-3, flat_mode="global")
+    opt.init({"w": jnp.zeros((4,), jnp.float32)})
+    ts = build_train_step(lambda p, mb: jnp.sum(p["w"]) * 0.0, opt)
+    train_live = set(inspect.signature(build_train_step).parameters)
+    train_live |= {f.name for f in dataclasses.fields(DistributedFusedAdam)}
+    train_live |= set(opt.stats())
+    train_live |= set(vars(ts))
+    train_live |= {n for n in dir(type(ts)) if not n.startswith("_")}
+    for n in TRAIN_SHARDED_NAMES:
+        if n not in train_live:
+            raise AssertionError(
+                f"TRAIN_SHARDED_NAMES lists {n!r}, which is no longer "
+                "a live build_train_step parameter, DistributedFusedAdam "
+                "field, stats() key, or TrainStep attribute — update "
+                "tools/check_docs.py")
+        names.append(("train sharded surface", n))
     return names
 
 
@@ -240,6 +281,7 @@ def main():
     fleet_text = _docs_text(FLEET_DOCS)
     robustness_text = _docs_text(ROBUSTNESS_DOCS)
     mesh_text = _docs_text(MESH_DOCS)
+    train_text = _docs_text(TRAIN_DOCS)
     missing = []
     for kind, name in collect_names():
         if kind in OBS_KINDS:
@@ -253,6 +295,8 @@ def main():
         elif (kind in PROCESS_KINDS or kind in AUTOSCALE_KINDS
                 or kind in DISAGG_KINDS or kind in SHARED_TIER_KINDS):
             text, where = fleet_text, FLEET_DOCS
+        elif kind in TRAIN_SHARDED_KINDS:
+            text, where = train_text, TRAIN_DOCS
         else:
             text, where = serving_text, SERVING_DOCS
         if name not in text:

@@ -9,7 +9,7 @@ optimizer) against the 141 TFLOP/s measured matmul ceiling.
 
 Usage:  python tools/profile_step.py [component ...]
         components: attn encoder tail matmul embed opt step
-                    dequant_gemm
+                    dequant_gemm train_sharded
         (default: all; `opt` needs a ~10-minute standalone compile)
 """
 
@@ -368,10 +368,52 @@ def prof_opt(fraction=1.0):
     return None
 
 
+def prof_train_sharded():
+    """GPT-tiny 3D-parallel fused train step (docs/training.md
+    "Sharded training") on the largest (batch, model) mesh this host's
+    devices allow, chained-carry timed like every other component;
+    also prints the AOT-audited per-step collective totals so the
+    wall-clock attributes to the ZeRO/TP legs, not to guesswork."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel, lm_loss
+    from apex_tpu.serving.mesh import build_mesh
+    from apex_tpu.train import build_train_step
+
+    n = jax.device_count()
+    shape = (2, 2) if n >= 4 else ((1, 2) if n >= 2 else (1, 1))
+    cfg = GPTConfig.tiny(dropout=0.0, remat=False)
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.RandomState(_SALT)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 4, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens[0])["params"]
+
+    def loss_fn(p, mb):
+        return lm_loss(model.apply({"params": p}, mb), mb)
+
+    ts = build_train_step(
+        loss_fn, DistributedFusedAdam(lr=1e-3, flat_mode="global"),
+        accum_steps=2, mesh=build_mesh(shape), num_heads=cfg.num_heads)
+    state = ts.init(params)
+    audit = ts.audit_collectives(state, tokens)
+    total = audit["collectives"]["total"]["ops"]
+
+    def step(st):
+        st2, _ = ts.step(st, tokens)
+        return (st2,)
+
+    dt = _chain(step, (state,))
+    print(f"train-sharded GPT-tiny @ mesh{shape}: {dt*1e3:7.2f} ms/step "
+          f"({1.0/dt:5.2f} steps/s; {total} collectives/step, donation "
+          f"aliases {audit['alias']['pairs']} covering "
+          f"{audit['sharded_leaves']} sharded leaves)")
+    return dt
+
+
 COMPONENTS = {"attn": prof_attention, "encoder": prof_encoder,
               "tail": prof_tail, "matmul": prof_matmul,
               "embed": prof_embed, "opt": prof_opt, "step": prof_step,
-              "dequant_gemm": prof_dequant_gemm}
+              "dequant_gemm": prof_dequant_gemm,
+              "train_sharded": prof_train_sharded}
 
 
 def main():

@@ -174,6 +174,15 @@ def summarize(dump: Dict) -> str:
             f"({sum(int(e.get('bytes', 0)) for e in pubs)} bytes), "
             f"{sum(int(e.get('blocks', 0)) for e in shits)} blocks "
             f"seeded into replicas across {len(shits)} hits")
+    tsteps = [e for e in rec_events if e.get("kind") == "train_step"]
+    meshed = [e for e in tsteps if e.get("mesh")]
+    if meshed:
+        shape = "x".join(str(int(d)) for d in meshed[-1]["mesh"])
+        span = sum(float(e.get("host_span_s", 0.0)) for e in meshed)
+        lines.append(
+            f"-- sharded train: {len(meshed)}/{len(tsteps)} steps "
+            f"dispatched on the (batch, model)=({shape}) mesh "
+            f"({_fmt_s(span)} host span)")
     scrubs = [e for e in rec_events if e.get("kind") == "scrub"]
     corrupts = [e for e in rec_events
                 if e.get("kind") == "corruption_detected"]
